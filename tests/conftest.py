@@ -1,0 +1,38 @@
+"""Test-wide environment: hermetic CPU backend with 8 fake devices.
+
+The distributed test strategy (SURVEY.md §4.2): pjit sharding + collectives
+are validated on a fake multi-device CPU mesh via
+``--xla_force_host_platform_device_count`` — the substitute for the
+reference lineage's "run it on a Databricks cluster" manual testing.
+This must run before jax initializes, hence module top-level in conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment may pin a TPU platform via an explicit config update in
+# sitecustomize (which beats the env var) — force the hermetic CPU backend.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(dp=2, fsdp=2, sp=1, tp=2))
+
+
+@pytest.fixture
+def rng_np():
+    return np.random.default_rng(0)

@@ -1,0 +1,46 @@
+import jax
+import pytest
+from jax.sharding import PartitionSpec
+
+from tpudl.runtime.mesh import (
+    MESH_AXES,
+    MeshSpec,
+    batch_partition_spec,
+    make_mesh,
+)
+
+
+def test_fake_device_count():
+    assert len(jax.devices()) == 8
+
+
+def test_meshspec_resolve_wildcard():
+    assert MeshSpec(dp=-1).resolve(8) == (8, 1, 1, 1)
+    assert MeshSpec(dp=-1, fsdp=2).resolve(8) == (4, 2, 1, 1)
+    assert MeshSpec(dp=2, fsdp=2, tp=2).resolve(8) == (2, 2, 1, 2)
+
+
+def test_meshspec_errors():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, fsdp=3).resolve(8)
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["sp"] == 1
+    assert mesh.shape["tp"] == 2
+
+
+def test_make_mesh_default_all_dp():
+    mesh = make_mesh()
+    assert mesh.shape["dp"] == 8
+
+
+def test_batch_partition_spec():
+    assert batch_partition_spec() == PartitionSpec(("dp", "fsdp"))
+    assert batch_partition_spec(2) == PartitionSpec(("dp", "fsdp"), None, None)

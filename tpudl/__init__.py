@@ -1,0 +1,29 @@
+"""tpudl — TPU-native distributed deep learning framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design of the capability surface of
+`rafaelvp-db/databricks-distributed-deep-learning` (see SURVEY.md):
+
+- ``tpudl.runtime``  — device-mesh construction and the ``TpuDistributor``
+  launcher (replaces HorovodRunner / pyspark TorchDistributor; the reference
+  has no launcher in-tree, see SURVEY.md §2.3).
+- ``tpudl.data``     — Petastorm-style Parquet converter feeding per-host
+  sharded batches to JAX.
+- ``tpudl.models``   — Flax model families (CV: ResNet; NLP: BERT et al.),
+  replacing the reference's torchvision ResNet-50 usage
+  (reference: notebooks/cv/onnx_experiments.py:19) and the declared-but-empty
+  NLP family (reference: notebooks/nlp/README.md).
+- ``tpudl.ops``      — TPU kernels: fused/flash attention (Pallas), ring
+  attention for sequence/context parallelism.
+- ``tpudl.parallel`` — sharding rules (DP / FSDP / TP / SP) over a named mesh;
+  XLA collectives over ICI replace the lineage's NCCL allreduce.
+- ``tpudl.train``    — Optax train loops, metrics (images/sec/chip, MFU).
+- ``tpudl.export``   — StableHLO export, cross-backend numerical parity and
+  latency benchmarking — the reference's signature behavior
+  (reference: notebooks/cv/onnx_experiments.py:81-144) rebuilt as a
+  CPU-XLA vs TPU-XLA harness.
+
+See each subpackage's ``__init__`` for its current contents; subsystems land
+in the order of SURVEY.md §7.3.
+"""
+
+__version__ = "0.1.0"

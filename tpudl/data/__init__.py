@@ -1,0 +1,3 @@
+"""L1 data layer: Parquet converter + dataset helpers."""
+
+from tpudl.data.synthetic import synthetic_classification_batches  # noqa: F401

@@ -1,0 +1,9 @@
+"""L2 model families: CV (ResNet) and NLP (BERT, LoRA)."""
+
+from tpudl.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+)

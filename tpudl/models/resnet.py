@@ -1,0 +1,149 @@
+"""Flax ResNet family (18/34/50/101).
+
+TPU-native re-design of the CV workload family: the reference consumes
+torchvision's pretrained ResNet-50 (reference:
+notebooks/cv/onnx_experiments.py:19 `models.resnet50(pretrained=True)`) and
+exercises it through export/inference paths. Here the model is a first-party
+Flax module so it can be trained (BASELINE.json configs[0] ResNet-18/CIFAR-10,
+configs[2] ResNet-50/ImageNet DP) and exported/benched by tpudl.export.
+
+TPU notes:
+- NHWC layout (XLA's native conv layout on TPU; torchvision is NCHW).
+- bfloat16 compute / float32 params and batch-norm statistics.
+- ``small_inputs=True`` switches to the CIFAR stem (3x3 s1 conv, no pool).
+- Batch statistics are computed with global semantics: under pjit with the
+  batch axis sharded over (dp, fsdp), XLA turns the batch-mean reductions
+  into cross-replica collectives automatically — synchronized BatchNorm for
+  free, where the GPU lineage needs an explicit SyncBatchNorm wrapper.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpudl.parallel.sharding import constrain
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    """Basic 3x3+3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(
+                residual
+            )
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckResNetBlock(nn.Module):
+    """1x1-3x3-1x1 bottleneck block (ResNet-50/101)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet over NHWC images."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    act: Callable = nn.relu
+    small_inputs: bool = False  # CIFAR stem
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.he_normal(),
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = conv(self.num_filters, (3, 3), (1, 1), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = self.act(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = self.act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=self.act,
+                )(x)
+            x = constrain(x, ("dp", "fsdp"), None, None, None)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=ResNetBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=ResNetBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckResNetBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckResNetBlock)
+
+#: Tiny variant for unit tests / CI (fast on the CPU backend).
+ResNetTiny = partial(
+    ResNet, stage_sizes=(1, 1), block_cls=ResNetBlock, num_filters=8, small_inputs=True
+)

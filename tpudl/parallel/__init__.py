@@ -1,0 +1,11 @@
+"""Parallelism: sharding rules and activation constraints over the mesh."""
+
+from tpudl.parallel.sharding import (  # noqa: F401
+    Rules,
+    active_mesh,
+    constrain,
+    current_mesh,
+    param_shardings,
+    spec_for_path,
+    tree_shardings,
+)

@@ -1,0 +1,169 @@
+"""Sharding-rule engine: map parameter paths to PartitionSpecs.
+
+The reference lineage distributes by wrapping the model in Horovod /
+DistributedDataParallel hooks (SURVEY.md §2.3 — absent from the reference
+tree itself). The TPU-native design is declarative instead: a list of
+``(path_regex, PartitionSpec)`` rules assigns every parameter a sharding
+over the named mesh (tpudl.runtime.mesh.MESH_AXES); pjit/GSPMD then emits
+the ICI collectives. Strategy presets (DP / FSDP / TP) are just different
+rule lists.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+#: A rule list: first regex (searched, not fullmatch) wins.
+Rules = Sequence[Tuple[str, PartitionSpec]]
+
+#: Fully-replicated default.
+REPLICATED = P()
+
+
+def _path_str(path) -> str:
+    """'params/Dense_0/kernel'-style path string from a tree path."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for_path(
+    path: str, rules: Optional[Rules], shape: Sequence[int] = ()
+) -> PartitionSpec:
+    """First matching rule wins. A rule's spec may be a PartitionSpec or a
+    callable ``shape -> PartitionSpec`` (for rank-dependent placement, e.g.
+    conv vs dense kernels under FSDP)."""
+    if rules:
+        for pattern, spec in rules:
+            if re.search(pattern, path):
+                return spec(shape) if callable(spec) else spec
+    return REPLICATED
+
+
+def _clamp_entries(mesh: Mesh, spec: PartitionSpec, shape) -> PartitionSpec:
+    """Truncate a spec to the array rank and unshard any dimension whose size
+    the named mesh axes don't divide — keeps one rule list usable across
+    full-size and tiny-test configurations."""
+    entries = list(spec)[: len(shape)]
+    fixed = []
+    for dim, entry in enumerate(entries):
+        if entry is None:
+            fixed.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for n in names:
+            size *= mesh.shape[n]
+        fixed.append(entry if shape[dim] % size == 0 else None)
+    return P(*fixed)
+
+
+def tree_shardings(
+    mesh: Mesh, tree: Any, rules: Optional[Rules] = None
+) -> Any:
+    """NamedSharding pytree for `tree` by matching paths against `rules`,
+    with per-dimension divisibility clamping (see _clamp_entries)."""
+
+    def one(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        spec = spec_for_path(_path_str(path), rules, shape)
+        return NamedSharding(mesh, _clamp_entries(mesh, spec, shape))
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def param_shardings(mesh: Mesh, params: Any, rules: Optional[Rules] = None) -> Any:
+    return tree_shardings(mesh, params, rules)
+
+
+# ---------------------------------------------------------------------------
+# Activation-sharding constraints.
+#
+# Model code calls ``constrain(x, ('dp','fsdp'), 'sp', None)`` on hot
+# activations. Outside any mesh context this is a no-op, so models run
+# unmodified on a single device.
+# ---------------------------------------------------------------------------
+
+_ctx = threading.local()
+
+
+def current_mesh() -> Optional[Mesh]:
+    return getattr(_ctx, "mesh", None)
+
+
+@contextlib.contextmanager
+def active_mesh(mesh: Optional[Mesh]):
+    prev = current_mesh()
+    _ctx.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _ctx.mesh = prev
+
+
+def constrain(x: jax.Array, *spec_entries) -> jax.Array:
+    """with_sharding_constraint against the active mesh (no-op without one).
+
+    Entries naming mesh axes whose size doesn't divide the corresponding
+    array dimension are dropped, so the same model code serves full-scale
+    and tiny-test shapes.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = _clamp_entries(mesh, P(*spec_entries), x.shape)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# Strategy presets (SURVEY.md §2.3 checklist).
+# ---------------------------------------------------------------------------
+
+#: Pure data parallelism: every parameter replicated.
+DP_RULES: Rules = ()
+
+
+def _fsdp_largest_dim(shape) -> PartitionSpec:
+    """Shard the largest dimension over the fsdp axis (rank-agnostic: for a
+    (kh, kw, in, out) conv kernel this picks the channel dim, not kh)."""
+    if not shape:
+        return REPLICATED
+    largest = max(range(len(shape)), key=lambda d: shape[d])
+    entries = [None] * len(shape)
+    entries[largest] = "fsdp"
+    return P(*entries)
+
+
+#: FSDP / ZeRO-3-style: shard the largest dim of every weight over the fsdp
+#: axis; XLA all-gathers per layer and reduce-scatters grads.
+FSDP_RULES: Rules = (
+    (r"embedding$", P("fsdp", None)),
+    (r"kernel$", _fsdp_largest_dim),
+)
+
+#: Tensor parallelism for transformer blocks (megatron-style column/row
+#: split), composed with fsdp on the other dim.
+TP_TRANSFORMER_RULES: Rules = (
+    (r"(query|key|value|q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tp")),
+    (r"(out|o_proj|attention_output)/kernel$", P("tp", "fsdp")),
+    (r"(intermediate|wi|up_proj|gate_proj|mlp_in)/kernel$", P("fsdp", "tp")),
+    (r"(output|wo|down_proj|mlp_out)/kernel$", P("tp", "fsdp")),
+    (r"(embedding|word_embeddings)/embedding$", P("tp", "fsdp")),
+    (r"kernel$", P("fsdp", None)),
+)

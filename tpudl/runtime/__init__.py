@@ -1,0 +1,12 @@
+"""L0 runtime: device/mesh discovery and distributed bring-up."""
+
+from tpudl.runtime.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MESH_AXES,
+    MeshSpec,
+    batch_partition_spec,
+    make_mesh,
+)

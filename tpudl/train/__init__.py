@@ -1,0 +1,11 @@
+"""L3 training: Optax loops, pjit sharding, metrics."""
+
+from tpudl.train.loop import (  # noqa: F401
+    TrainState,
+    compile_step,
+    create_train_state,
+    cross_entropy_loss,
+    fit,
+    make_classification_eval_step,
+    make_classification_train_step,
+)

@@ -1,0 +1,199 @@
+"""Optax training loops under pjit.
+
+Replaces the reference lineage's PyTorch/Lightning train loops driven by
+HorovodRunner / TorchDistributor (BASELINE.json `north_star`; the reference
+tree itself contains no training code — SURVEY.md §0). Structural
+difference from the Horovod design: gradient synchronization is not a
+framework hook — sharding annotations on the step's inputs/outputs make
+GSPMD emit psum/reduce-scatter inside the one compiled XLA executable per
+step (SURVEY.md §3.6, §5.8).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpudl.parallel.sharding import Rules, active_mesh, tree_shardings
+from tpudl.runtime.mesh import batch_partition_spec
+
+
+class TrainState(train_state.TrainState):
+    """TrainState extended with BatchNorm running statistics."""
+
+    batch_stats: Any = None
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    sample_input: jax.Array,
+    tx: optax.GradientTransformation,
+    init_kwargs: Optional[dict] = None,
+) -> TrainState:
+    variables = model.init(rng, sample_input, **(init_kwargs or {"train": False}))
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats"),
+        tx=tx,
+    )
+
+
+def cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+) -> jax.Array:
+    if label_smoothing > 0.0:
+        num_classes = logits.shape[-1]
+        onehot = optax.smooth_labels(
+            jax.nn.one_hot(labels, num_classes), label_smoothing
+        )
+        return optax.softmax_cross_entropy(logits, onehot).mean()
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_classification_train_step(
+    label_smoothing: float = 0.0,
+    input_key: str = "image",
+    label_key: str = "label",
+) -> Callable:
+    """Train step for image/sequence classification models.
+
+    Works with or without BatchNorm state. All reductions (loss mean, batch
+    statistics) have global semantics under pjit: with the batch sharded
+    over (dp, fsdp) they compile to ICI collectives — synchronized BN and
+    gradient all-reduce with zero framework code.
+    """
+
+    def step(state: TrainState, batch: dict, rng: jax.Array):
+        step_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            variables = {"params": params}
+            if state.batch_stats is not None:
+                variables["batch_stats"] = state.batch_stats
+                outputs, mutated = state.apply_fn(
+                    variables,
+                    batch[input_key],
+                    train=True,
+                    mutable=["batch_stats"],
+                    rngs={"dropout": step_rng},
+                )
+                new_stats = mutated["batch_stats"]
+            else:
+                outputs = state.apply_fn(
+                    variables, batch[input_key], train=True, rngs={"dropout": step_rng}
+                )
+                new_stats = None
+            loss = cross_entropy_loss(outputs, batch[label_key], label_smoothing)
+            return loss, (outputs, new_stats)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        if new_stats is not None:
+            new_state = new_state.replace(batch_stats=new_stats)
+        metrics = {
+            "loss": loss,
+            "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch[label_key]),
+        }
+        return new_state, metrics
+
+    return step
+
+
+def make_classification_eval_step(
+    input_key: str = "image", label_key: str = "label"
+) -> Callable:
+    def step(state: TrainState, batch: dict):
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        logits = state.apply_fn(variables, batch[input_key], train=False)
+        return {
+            "loss": cross_entropy_loss(logits, batch[label_key]),
+            "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch[label_key]),
+        }
+
+    return step
+
+
+def compile_step(
+    step_fn: Callable,
+    mesh: Mesh,
+    state: TrainState,
+    rules: Optional[Rules] = None,
+    donate_state: bool = True,
+    has_rng: bool = True,
+) -> Callable:
+    """jit a (state, batch[, rng]) step with mesh shardings.
+
+    - state (params / opt state / batch stats) sharded by `rules`
+      (replicated for pure DP, fsdp/tp specs for sharded training);
+    - batch sharded over the (dp, fsdp) axes on dim 0;
+    - metrics replicated.
+    """
+    state_sh = tree_shardings(mesh, state, rules)
+    batch_sh = NamedSharding(mesh, batch_partition_spec())
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    if has_rng:
+        jitted = jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh, repl),
+            out_shardings=(state_sh, None),
+            donate_argnums=(0,) if donate_state else (),
+        )
+    else:
+        jitted = jax.jit(
+            step_fn,
+            in_shardings=(state_sh, batch_sh),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    def wrapped(*args):
+        with active_mesh(mesh):
+            return jitted(*args)
+
+    wrapped.jitted = jitted  # expose for lower()/cost analysis
+    wrapped.state_shardings = state_sh
+    wrapped.batch_sharding = batch_sh
+    return wrapped
+
+
+def fit(
+    compiled_step: Callable,
+    state: TrainState,
+    batches: Iterable[dict],
+    rng: jax.Array,
+    num_steps: Optional[int] = None,
+    log_every: int = 0,
+    logger: Optional[Callable[[int, dict], None]] = None,
+):
+    """Drive the compiled step over a batch iterator; returns final state and
+    the last metrics (host-synced once at the end, not per step)."""
+    metrics = None
+    start = time.perf_counter()
+    n = 0
+    for i, batch in enumerate(batches):
+        if num_steps is not None and i >= num_steps:
+            break
+        state, metrics = compiled_step(state, batch, rng)
+        n += 1
+        if log_every and (i + 1) % log_every == 0:
+            host_metrics = {k: float(v) for k, v in metrics.items()}
+            if logger:
+                logger(i + 1, host_metrics)
+            else:
+                print(f"step {i + 1}: {host_metrics}")
+    if metrics is not None:
+        metrics = {k: float(v) for k, v in metrics.items()}
+    elapsed = time.perf_counter() - start
+    return state, metrics, {"steps": n, "seconds": elapsed}

@@ -1,0 +1,123 @@
+"""Throughput and MFU accounting.
+
+The reference reports wall-clock latency means from Python lists
+(reference: notebooks/cv/onnx_experiments.py:90-104,130-140). Here the two
+BASELINE.json `metric` quantities — images/sec/chip and samples/sec — plus
+MFU are first-class (SURVEY.md §5.5). FLOPs come from the compiled
+executable's cost analysis with an analytic fallback.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+#: Peak dense bf16 FLOP/s per chip. Sources: public TPU spec sheets.
+PEAK_FLOPS = {
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "cpu": 1e12,  # nominal; MFU on CPU backend is not meaningful
+}
+
+
+def device_peak_flops(device: Optional[jax.Device] = None) -> float:
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "cpu")
+    for name, peak in PEAK_FLOPS.items():
+        if name.lower() in str(kind).lower():
+            return peak
+    return PEAK_FLOPS["cpu"]
+
+
+def compiled_flops(lowered_or_compiled) -> Optional[float]:
+    """FLOPs per invocation from XLA cost analysis, if the backend reports it."""
+    try:
+        compiled = (
+            lowered_or_compiled.compile()
+            if hasattr(lowered_or_compiled, "compile")
+            else lowered_or_compiled
+        )
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops = cost.get("flops")
+        return float(flops) if flops else None
+    except Exception:
+        return None
+
+
+def transformer_train_flops(num_params: int, tokens_per_step: int) -> float:
+    """Analytic fallback: 6*N*D for a transformer fwd+bwd step."""
+    return 6.0 * num_params * tokens_per_step
+
+
+def mfu(
+    flops_per_step: float,
+    step_seconds: float,
+    num_chips: int = 1,
+    peak_per_chip: Optional[float] = None,
+) -> float:
+    if peak_per_chip is None:
+        peak_per_chip = device_peak_flops()
+    return flops_per_step / (step_seconds * num_chips * peak_per_chip)
+
+
+class Throughput:
+    """Steady-state throughput meter: skips warmup/compile steps, blocks on
+    device results only at boundaries (the reference times cold calls and
+    includes host transfer in the window — SURVEY.md §5.1)."""
+
+    def __init__(self, items_per_step: int, warmup: int = 2):
+        self.items_per_step = items_per_step
+        self.warmup = warmup
+        self._count = 0
+        # warmup=0 means "count every step": the window opens at construction.
+        self._start = time.perf_counter() if warmup == 0 else None
+        self._measured_steps = 0
+
+    def step(self, sync_value=None):
+        self._count += 1
+        if self._count == self.warmup:
+            if sync_value is not None:
+                jax.block_until_ready(sync_value)
+            self._start = time.perf_counter()
+        elif self._count > self.warmup:
+            self._measured_steps += 1
+
+    def result(self, sync_value=None) -> dict:
+        if sync_value is not None:
+            jax.block_until_ready(sync_value)
+        elapsed = time.perf_counter() - self._start if self._start else 0.0
+        steps = max(self._measured_steps, 1)
+        per_sec = self.items_per_step * steps / elapsed if elapsed > 0 else 0.0
+        return {
+            "steps_measured": self._measured_steps,
+            "seconds": elapsed,
+            "items_per_sec": per_sec,
+            "step_ms": 1000.0 * elapsed / steps if elapsed > 0 else 0.0,
+        }
+
+
+def measure_step_time(
+    fn: Callable, *args, warmup: int = 3, iters: int = 10
+) -> float:
+    """Mean seconds per call with warmup excluded and device sync at the
+    boundaries (fixes the reference's cold-call timing at
+    notebooks/cv/onnx_experiments.py:92-95)."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - start) / iters
