@@ -1,0 +1,79 @@
+"""Driver benchmark: one JSON line with the headline metric.
+
+Measures steady-state training throughput of the BASELINE.json configs[0]
+workload (ResNet-18 / CIFAR-10-shaped data) on the real device. The
+reference publishes no numbers (BASELINE.md — `"published": {}`), so
+``vs_baseline`` is reported against the first value this repo banked in
+BASELINE.md (images/sec on 1x TPU v5 lite); until one exists it is 1.0.
+
+Timing protocol (see .claude/skills/verify/SKILL.md): the remote-TPU relay
+makes `block_until_ready` unreliable for timing, so every window is closed
+by a scalar host readback, and a long warmup burst absorbs relay buffering.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# Value banked in BASELINE.md for this metric (images/sec, 1x TPU v5 lite).
+BASELINE_IMAGES_PER_SEC = 29000.0
+
+BATCH = 256
+WARMUP_STEPS = 25
+MEASURE_STEPS = 50
+
+
+def main():
+    from tpudl.data.synthetic import synthetic_classification_batches
+    from tpudl.models import ResNet18
+    from tpudl.runtime import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        make_classification_train_step,
+    )
+
+    model = ResNet18(num_classes=10, small_inputs=True)
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, 32, 32, 3)),
+        optax.sgd(0.1, momentum=0.9),
+    )
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(make_classification_train_step(), mesh, state, None)
+
+    batch = next(
+        synthetic_classification_batches(BATCH, image_shape=(32, 32, 3), num_classes=10)
+    )
+    batch = jax.device_put(batch)
+    rng = jax.random.key(1)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])  # close the warmup window with a readback
+
+    start = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = BATCH * MEASURE_STEPS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "resnet18_cifar10_train_throughput",
+                "value": round(images_per_sec, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""The driver contracts must keep working (see __graft_entry__.py)."""
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_entry_signature():
+    fn, args = graft.entry()
+    # Shape-check the flagship forward without paying for a CPU compile.
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == (8, 1000)

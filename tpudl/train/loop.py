@@ -130,7 +130,7 @@ def compile_step(
     mesh: Mesh,
     state: TrainState,
     rules: Optional[Rules] = None,
-    donate_state: bool = True,
+    donate_state: Optional[bool] = None,
     has_rng: bool = True,
 ) -> Callable:
     """jit a (state, batch[, rng]) step with mesh shardings.
@@ -139,7 +139,14 @@ def compile_step(
       (replicated for pure DP, fsdp/tp specs for sharded training);
     - batch sharded over the (dp, fsdp) axes on dim 0;
     - metrics replicated.
+
+    ``donate_state`` defaults to ``has_rng``: train steps (which take an rng
+    and return a new state) donate the old state's buffers; eval steps
+    (``has_rng=False``, returning only metrics) must NOT donate or the
+    caller's state would be destroyed on first use.
     """
+    if donate_state is None:
+        donate_state = has_rng
     state_sh = tree_shardings(mesh, state, rules)
     batch_sh = NamedSharding(mesh, batch_partition_spec())
     repl = NamedSharding(mesh, PartitionSpec())

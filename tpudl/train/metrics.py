@@ -95,11 +95,18 @@ class Throughput:
     def result(self, sync_value=None) -> dict:
         if sync_value is not None:
             jax.block_until_ready(sync_value)
-        elapsed = time.perf_counter() - self._start if self._start else 0.0
-        steps = max(self._measured_steps, 1)
+        if self._measured_steps == 0 or self._start is None:
+            return {
+                "steps_measured": 0,
+                "seconds": 0.0,
+                "items_per_sec": 0.0,
+                "step_ms": 0.0,
+            }
+        elapsed = time.perf_counter() - self._start
+        steps = self._measured_steps
         per_sec = self.items_per_step * steps / elapsed if elapsed > 0 else 0.0
         return {
-            "steps_measured": self._measured_steps,
+            "steps_measured": steps,
             "seconds": elapsed,
             "items_per_sec": per_sec,
             "step_ms": 1000.0 * elapsed / steps if elapsed > 0 else 0.0,
