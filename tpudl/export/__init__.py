@@ -1,0 +1,20 @@
+"""L4 export/serve: StableHLO export, cross-backend parity, latency bench.
+
+The reference's signature behavior (SURVEY.md §0): serialize a model to
+multiple formats (ONNX / TorchScript / pickle — reference
+notebooks/cv/onnx_experiments.py:33-42,198,206-215), run it on multiple
+backends (ONNX Runtime / OpenVINO — :77-140), compare outputs numerically
+(:142-144) and report latency (:104,140). Rebuilt TPU-native: one jaxpr
+lowered to CPU-XLA and TPU-XLA plays the "two independent backends compiled
+from one artifact" role; jax.export/StableHLO is the serialization format.
+"""
+
+from tpudl.export.export import (  # noqa: F401
+    artifact_sizes,
+    export_stablehlo,
+    load_exported,
+    load_params,
+    save_params,
+)
+from tpudl.export.parity import ParityReport, assert_parity, check_parity  # noqa: F401
+from tpudl.export.latency import latency_benchmark  # noqa: F401

@@ -1,0 +1,83 @@
+"""Inference latency benchmark harness.
+
+Fixes the measurement-design flaws of the reference's harness
+(reference notebooks/cv/onnx_experiments.py:90-104,130-139 — cold calls
+timed, host transfer inside the latency window, OpenVINO "mean" over a
+single sample, `latency` mutated as a closure global):
+- warmup iterations excluded;
+- host->device transfer timed separately from compute;
+- percentiles, not just the mean;
+- every timing window closed by a scalar host readback (required for
+  correctness on relay-attached devices where block_until_ready can
+  return early — see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def _sync(out) -> float:
+    """Force completion of `out`'s computation via a scalar readback."""
+    leaf = jax.tree.leaves(out)[0]
+    return float(np.asarray(leaf).ravel()[0])
+
+
+def latency_benchmark(
+    fn: Callable,
+    host_args: Sequence[Any],
+    device: Optional[jax.Device] = None,
+    warmup: int = 5,
+    iters: int = 30,
+) -> dict:
+    """Benchmark `fn` with transfer and compute measured separately."""
+    if iters < 1:
+        raise ValueError(f"iters must be >= 1, got {iters}")
+    if device is None:
+        device = jax.devices()[0]
+    jitted = jax.jit(fn)
+
+    # --- transfer: host -> device, timed per iteration ---
+    transfer_ms = []
+    for _ in range(warmup):
+        placed = jax.tree.map(lambda a: jax.device_put(a, device), tuple(host_args))
+        jax.block_until_ready(placed)
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        placed = jax.tree.map(lambda a: jax.device_put(a, device), tuple(host_args))
+        jax.block_until_ready(placed)
+        transfer_ms.append((time.perf_counter() - t0) * 1e3)
+
+    # --- compute: device-resident args, synced by scalar readback ---
+    # warmup=0 means the first timed iteration includes compilation.
+    out = None
+    for _ in range(warmup):
+        out = jitted(*placed)
+    if out is not None:
+        _sync(out)
+    compute_ms = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = jitted(*placed)
+        _sync(out)
+        compute_ms.append((time.perf_counter() - t0) * 1e3)
+
+    def stats(xs):
+        xs = np.asarray(xs)
+        return {
+            "mean_ms": float(xs.mean()),
+            "p50_ms": float(np.percentile(xs, 50)),
+            "p95_ms": float(np.percentile(xs, 95)),
+            "min_ms": float(xs.min()),
+        }
+
+    return {
+        "device": str(device),
+        "iters": iters,
+        "transfer": stats(transfer_ms),
+        "compute": stats(compute_ms),
+    }

@@ -1,0 +1,135 @@
+"""Cross-backend numerical parity harness.
+
+The reference's only correctness verification is comparing OpenVINO output
+against ONNX Runtime output with np.allclose(rtol=1e-05, atol=1e-04)
+(reference notebooks/cv/onnx_experiments.py:142-144) — two independent
+backends compiled from one artifact. TPU-native analog: one function run on
+CPU-XLA and TPU-XLA and compared at the same tolerances (SURVEY.md §3.3).
+
+TPU-specific reality the reference never faced: f32 matmuls ride the MXU at
+bf16 input precision by default, so the reference's f32 tolerances only
+hold under ``jax.default_matmul_precision('highest')``. The harness exposes
+both modes:
+- strict=True  — HIGHEST matmul precision, reference tolerances
+                 (rtol=1e-5, atol=1e-4): verifies the math.
+- strict=False — deployment precision (bf16 MXU), loose tolerances
+                 (rtol=2e-2, atol=2e-2): verifies the deployed artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+#: f32 tolerances from reference notebooks/cv/onnx_experiments.py:144.
+STRICT_RTOL, STRICT_ATOL = 1e-5, 1e-4
+#: bf16-MXU deployment tolerances.
+DEPLOY_RTOL, DEPLOY_ATOL = 2e-2, 2e-2
+
+
+@dataclasses.dataclass
+class ParityReport:
+    ok: bool
+    rtol: float
+    atol: float
+    backend_a: str
+    backend_b: str
+    max_abs_err: float
+    max_rel_err: float
+    num_outputs: int
+
+    def __str__(self):
+        status = "PASS" if self.ok else "FAIL"
+        return (
+            f"parity {status}: {self.backend_a} vs {self.backend_b} "
+            f"rtol={self.rtol} atol={self.atol} "
+            f"max_abs={self.max_abs_err:.3e} max_rel={self.max_rel_err:.3e}"
+        )
+
+
+def _run_on(fn: Callable, args, device: jax.Device):
+    placed = jax.tree.map(lambda a: jax.device_put(a, device), tuple(args))
+    out = jax.jit(fn)(*placed)
+    return jax.tree.map(np.asarray, out)
+
+
+def compare_outputs(
+    out_a: Any,
+    out_b: Any,
+    rtol: float,
+    atol: float,
+    backend_a: str = "a",
+    backend_b: str = "b",
+) -> ParityReport:
+    """Numerically compare two output pytrees leaf-by-leaf."""
+    leaves_a = jax.tree.leaves(out_a)
+    leaves_b = jax.tree.leaves(out_b)
+    ok = len(leaves_a) == len(leaves_b)
+    max_abs = 0.0
+    max_rel = 0.0
+    for a, b in zip(leaves_a, leaves_b):
+        a64 = np.asarray(a, np.float64)
+        b64 = np.asarray(b, np.float64)
+        abs_err = np.abs(a64 - b64)
+        max_abs = max(max_abs, float(abs_err.max(initial=0.0)))
+        denom = np.abs(b64) + 1e-12
+        max_rel = max(max_rel, float((abs_err / denom).max(initial=0.0)))
+        if not np.allclose(a64, b64, rtol=rtol, atol=atol):
+            ok = False
+    return ParityReport(
+        ok=ok,
+        rtol=rtol,
+        atol=atol,
+        backend_a=backend_a,
+        backend_b=backend_b,
+        max_abs_err=max_abs,
+        max_rel_err=max_rel,
+        num_outputs=len(leaves_a),
+    )
+
+
+def check_parity(
+    fn: Callable,
+    args: Sequence[Any],
+    device_a: Optional[jax.Device] = None,
+    device_b: Optional[jax.Device] = None,
+    rtol: Optional[float] = None,
+    atol: Optional[float] = None,
+    strict: bool = True,
+) -> ParityReport:
+    """Run `fn(*args)` on two backends and compare outputs numerically."""
+    if device_a is None:
+        device_a = jax.devices()[0]
+    if device_b is None:
+        device_b = jax.devices("cpu")[0]
+    if rtol is None:
+        rtol = STRICT_RTOL if strict else DEPLOY_RTOL
+    if atol is None:
+        atol = STRICT_ATOL if strict else DEPLOY_ATOL
+
+    if strict:
+        with jax.default_matmul_precision("highest"):
+            out_a = _run_on(fn, args, device_a)
+            out_b = _run_on(fn, args, device_b)
+    else:
+        out_a = _run_on(fn, args, device_a)
+        out_b = _run_on(fn, args, device_b)
+
+    return compare_outputs(
+        out_a,
+        out_b,
+        rtol,
+        atol,
+        backend_a=str(device_a.platform),
+        backend_b=str(device_b.platform),
+    )
+
+
+def assert_parity(fn, args, **kwargs) -> ParityReport:
+    report = check_parity(fn, args, **kwargs)
+    if not report.ok:
+        raise AssertionError(str(report))
+    return report

@@ -1,0 +1,28 @@
+"""Model registry: config model names -> Flax module instances."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from tpudl.models.resnet import ResNet18, ResNet34, ResNet50, ResNet101
+
+
+def build_model(name: str, num_classes: int, **kwargs: Any):
+    """Build the Flax module for a config `model` name (tpudl.config)."""
+    dtype = kwargs.pop("dtype", jnp.bfloat16)
+    cv = {
+        "resnet18": ResNet18,
+        "resnet34": ResNet34,
+        "resnet50": ResNet50,
+        "resnet101": ResNet101,
+    }
+    if name in cv:
+        return cv[name](num_classes=num_classes, dtype=dtype, **kwargs)
+    if name.startswith("bert") or name.startswith("llama"):
+        raise NotImplementedError(
+            f"model '{name}' is scheduled in SURVEY.md §7.3 (NLP family) "
+            "and not built yet"
+        )
+    raise ValueError(f"unknown model name: {name!r}")
