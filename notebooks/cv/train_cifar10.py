@@ -3,7 +3,7 @@
 The CV training workload the reference lineage runs through
 HorovodRunner/Lightning on GPU clusters, as a single-process TPU run.
 With no network egress, data is the learnable synthetic CIFAR-shaped
-stream; pass --data-dir with a Parquet directory for real CIFAR-10.
+stream (the Parquet converter in tpudl.data feeds real datasets).
 
 Run: python notebooks/cv/train_cifar10.py [--steps N]
 """

@@ -1,0 +1,82 @@
+"""Module-level functions for TpuDistributor spawn tests (must be
+importable/picklable by reference from worker subprocesses)."""
+
+
+def report_topology():
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+
+
+def global_sum():
+    """Each process contributes (process_index+1) per local device; the jitted
+    global sum must see every process's contribution."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(jax.devices(), ("dp",))
+    local = jnp.ones((jax.local_device_count(),)) * (jax.process_index() + 1)
+    arr = multihost_utils.host_local_array_to_global_array(local, mesh, P("dp"))
+    total = jax.jit(
+        lambda a: a.sum(),
+        in_shardings=NamedSharding(mesh, P("dp")),
+        out_shardings=NamedSharding(mesh, P()),
+    )(arr)
+    return float(total)
+
+
+def distributed_train_smoke():
+    """A tiny pjit DP train run inside each spawned process — the full
+    launcher -> mesh -> sharded step path (SURVEY.md §3.6) minus real ICI."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.data.synthetic import synthetic_classification_batches
+    from tpudl.models.resnet import ResNetTiny
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        make_classification_train_step,
+    )
+
+    model = ResNetTiny(num_classes=4)
+    state = create_train_state(
+        jax.random.key(0), model, jnp.zeros((1, 16, 16, 3)), optax.sgd(0.05)
+    )
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(make_classification_train_step(), mesh, state, None)
+    # NOTE: with multiple processes each worker feeds its local shard; batches
+    # here are whole-batch because local == global in this smoke (the
+    # converter layer owns per-host sharding).
+    losses = []
+    rng = jax.random.key(1)
+    for batch in synthetic_classification_batches(
+        16, image_shape=(16, 16, 3), num_classes=4, num_batches=3
+    ):
+        import numpy as np
+
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as P
+
+        global_batch = {
+            k: multihost_utils.host_local_array_to_global_array(
+                v, mesh, P(("dp", "fsdp"))
+            )
+            for k, v in batch.items()
+        }
+        state, metrics = step(state, global_batch, rng)
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def failing_worker():
+    raise RuntimeError("intentional worker failure")

@@ -1,0 +1,56 @@
+"""TpuDistributor launch-path tests (SURVEY.md §4.2: localhost multi-process
+bring-up substitutes for the reference lineage's run-on-a-cluster testing)."""
+
+import pytest
+
+from tests import dist_helpers
+from tpudl.runtime.distributor import TpuDistributor
+
+
+def test_in_process_mode():
+    d = TpuDistributor(num_processes=1)
+    results = d.run(lambda x: x + 1, 41)
+    assert results == [42]
+
+
+def test_unpicklable_fn_error():
+    d = TpuDistributor(num_processes=2)
+    with pytest.raises(ValueError, match="picklable"):
+        d.run(lambda x: x, 1)
+
+
+@pytest.mark.slow
+def test_spawn_two_processes_topology():
+    d = TpuDistributor(num_processes=2, platform="cpu", devices_per_process=2)
+    results = d.run(dist_helpers.report_topology)
+    assert [r["process_index"] for r in results] == [0, 1]
+    for r in results:
+        assert r["process_count"] == 2
+        assert r["local_devices"] == 2
+        assert r["global_devices"] == 4
+
+
+@pytest.mark.slow
+def test_spawn_global_collective():
+    d = TpuDistributor(num_processes=2, platform="cpu", devices_per_process=2)
+    results = d.run(dist_helpers.global_sum)
+    # 2 devices * 1.0 (proc 0) + 2 devices * 2.0 (proc 1) = 6.0 on every rank
+    assert results == [6.0, 6.0]
+
+
+@pytest.mark.slow
+def test_spawn_distributed_train_smoke():
+    d = TpuDistributor(num_processes=2, platform="cpu", devices_per_process=2)
+    results = d.run(dist_helpers.distributed_train_smoke)
+    for losses in results:
+        assert len(losses) == 3
+        assert all(l == l for l in losses)  # no NaNs
+    # Both ranks computed the same global losses.
+    assert results[0] == pytest.approx(results[1])
+
+
+@pytest.mark.slow
+def test_worker_failure_propagates():
+    d = TpuDistributor(num_processes=2, platform="cpu", devices_per_process=1)
+    with pytest.raises(RuntimeError, match="intentional worker failure"):
+        d.run(dist_helpers.failing_worker)

@@ -22,8 +22,14 @@ import numpy as np
 
 
 def _sync(out) -> float:
-    """Force completion of `out`'s computation via a scalar readback."""
+    """Force completion of `out`'s computation via a scalar readback.
+
+    The slice executes on device, so only ONE element crosses to the host —
+    the timing window stays free of a full device-to-host copy.
+    """
     leaf = jax.tree.leaves(out)[0]
+    if isinstance(leaf, jax.Array):
+        return float(leaf.ravel()[0])
     return float(np.asarray(leaf).ravel()[0])
 
 

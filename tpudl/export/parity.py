@@ -51,8 +51,12 @@ class ParityReport:
 
 
 def _run_on(fn: Callable, args, device: jax.Device):
-    placed = jax.tree.map(lambda a: jax.device_put(a, device), tuple(args))
-    out = jax.jit(fn)(*placed)
+    # default_device so closure-captured constants (e.g. model params) follow
+    # the target backend instead of pinning the computation to where they
+    # were created; args are placed explicitly.
+    with jax.default_device(device):
+        placed = jax.tree.map(lambda a: jax.device_put(a, device), tuple(args))
+        out = jax.jit(fn)(*placed)
     return jax.tree.map(np.asarray, out)
 
 

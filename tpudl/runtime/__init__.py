@@ -1,5 +1,6 @@
 """L0 runtime: device/mesh discovery and distributed bring-up."""
 
+from tpudl.runtime.distributor import TpuDistributor  # noqa: F401
 from tpudl.runtime.mesh import (  # noqa: F401
     AXIS_DATA,
     AXIS_FSDP,

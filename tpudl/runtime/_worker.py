@@ -1,0 +1,45 @@
+"""Subprocess entry point for TpuDistributor local spawn.
+
+Reads TPUDL_* env (coordinator, process count/id, platform), brings up
+jax.distributed against the coordinator, runs the pickled payload, and
+writes ("ok", result) or ("error", traceback) to the result path.
+"""
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main() -> int:
+    payload_path, result_path = sys.argv[1], sys.argv[2]
+    coord = os.environ["TPUDL_COORDINATOR"]
+    nproc = int(os.environ["TPUDL_NUM_PROCESSES"])
+    pid = int(os.environ["TPUDL_PROCESS_ID"])
+    platform = os.environ.get("TPUDL_PLATFORM", "cpu")
+
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    jax.distributed.initialize(coord, num_processes=nproc, process_id=pid)
+
+    try:
+        with open(payload_path, "rb") as f:
+            fn, args, kwargs = pickle.load(f)
+        result = ("ok", fn(*args, **kwargs))
+        code = 0
+    except Exception:
+        result = ("error", traceback.format_exc())
+        code = 1
+
+    tmp = result_path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, result_path)
+
+    jax.distributed.shutdown()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""TpuDistributor: distributed process bring-up and launch.
+
+The TPU-native replacement for the reference lineage's HorovodRunner /
+pyspark TorchDistributor launch path ("NCCL allreduce on GPU workers",
+BASELINE.json `north_star`; the reference tree has no launcher —
+SURVEY.md §2.3). Structural differences from the Horovod design:
+
+- Bring-up is `jax.distributed.initialize(coordinator, num_processes,
+  process_id)` — one JAX process per host, not one per accelerator.
+- There are no framework-level collectives to install: gradient sync is
+  compiled into the step by GSPMD from sharding annotations and rides ICI
+  (TPU pods) or the Gloo/TCP fallback (CPU testing).
+
+Three modes:
+
+1. **In-process** (default, num_processes=1): `run(fn)` calls fn directly —
+   single-host single-process, the configs[0]/configs[1] shape.
+2. **Local spawn** (num_processes>1): N subprocesses against a localhost
+   coordinator, each with its own (CPU) device set — the cluster-free way
+   to exercise the real multi-process code path (SURVEY.md §4.2).
+3. **Pod** (`TpuDistributor.pod().ensure_initialized()`): on a real TPU pod
+   slice each host runs the same program; initialize() auto-detects
+   coordinator and process_id from the TPU metadata environment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, List, Optional, Sequence
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class TpuDistributor:
+    """Launches a callable across JAX processes.
+
+    Args:
+      num_processes: process count. 1 = run in-process.
+      coordinator_address: "host:port" for `jax.distributed.initialize`;
+        a free localhost port is picked when spawning locally.
+      platform: JAX platform for spawned workers ("cpu" for local testing,
+        "tpu" on pods). In-process mode never overrides the platform.
+      devices_per_process: fake host devices per worker (CPU platform only).
+      timeout_s: per-worker wall-clock limit for local spawn.
+    """
+
+    num_processes: int = 1
+    coordinator_address: Optional[str] = None
+    platform: str = "cpu"
+    devices_per_process: int = 1
+    timeout_s: float = 600.0
+
+    @classmethod
+    def pod(cls) -> "TpuDistributor":
+        """Distributor for a real TPU pod slice (one process per host)."""
+        d = cls(num_processes=-1, platform="tpu")
+        return d
+
+    def ensure_initialized(self) -> None:
+        """Bring up jax.distributed on a pod (idempotent).
+
+        Each host of the slice runs the same program and calls this once
+        before any device use; coordinator/process_id auto-detect from the
+        TPU environment.
+        """
+        import jax
+
+        if jax.process_count() > 1:
+            return
+        try:
+            if self.coordinator_address:
+                jax.distributed.initialize(
+                    self.coordinator_address,
+                    num_processes=self.num_processes,
+                    process_id=int(os.environ.get("TPUDL_PROCESS_ID", "0")),
+                )
+            else:
+                jax.distributed.initialize()
+        except (RuntimeError, ValueError) as e:
+            if "already" not in str(e).lower():
+                raise
+
+    # ------------------------------------------------------------------
+    # run()
+    # ------------------------------------------------------------------
+
+    def run(self, fn: Callable, *args: Any, **kwargs: Any) -> List[Any]:
+        """Run `fn(*args, **kwargs)` on every process; returns rank-ordered
+        results (the HorovodRunner(np=N).run(...) analog).
+
+        For local spawn, `fn` must be picklable by reference (a module-level
+        function) — the same constraint TorchDistributor places on its
+        train_fn in practice.
+        """
+        if self.num_processes == -1:
+            # Pod mode: every host runs this same program; bring up the
+            # slice-wide runtime, then run fn in-process on this host.
+            self.ensure_initialized()
+            return [fn(*args, **kwargs)]
+        if self.num_processes in (0, 1):
+            return [fn(*args, **kwargs)]
+        return self._spawn_local(fn, args, kwargs)
+
+    def _spawn_local(self, fn, args, kwargs) -> List[Any]:
+        try:
+            payload = pickle.dumps((fn, args, kwargs))
+        except Exception as e:
+            raise ValueError(
+                "TpuDistributor.run requires a module-level (picklable) "
+                f"function for multi-process launch; got {fn!r}: {e}"
+            ) from e
+
+        coord = self.coordinator_address or f"localhost:{_free_port()}"
+        workdir = tempfile.mkdtemp(prefix="tpudl_dist_")
+        try:
+            return self._spawn_in(workdir, coord, payload)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    def _spawn_in(self, workdir: str, coord: str, payload: bytes) -> List[Any]:
+        payload_path = os.path.join(workdir, "payload.pkl")
+        with open(payload_path, "wb") as f:
+            f.write(payload)
+
+        procs = []
+        for pid in range(self.num_processes):
+            env = dict(os.environ)
+            # Children must not re-register the host's exclusive accelerator
+            # plugin (a relay-attached TPU can't be shared N ways).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["TPUDL_COORDINATOR"] = coord
+            env["TPUDL_NUM_PROCESSES"] = str(self.num_processes)
+            env["TPUDL_PROCESS_ID"] = str(pid)
+            env["TPUDL_PLATFORM"] = self.platform
+            if self.platform == "cpu":
+                flags = env.get("XLA_FLAGS", "")
+                flags = " ".join(
+                    t
+                    for t in flags.split()
+                    if not t.startswith("--xla_force_host_platform_device_count")
+                )
+                env["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{self.devices_per_process}"
+                ).strip()
+            result_path = os.path.join(workdir, f"result_{pid}.pkl")
+            log_path = os.path.join(workdir, f"log_{pid}.txt")
+            # Logs go to files, not pipes: a worker blocked on a full pipe
+            # buffer would stall collectives on every other worker.
+            log_f = open(log_path, "w")
+            p = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "tpudl.runtime._worker",
+                    payload_path,
+                    result_path,
+                ],
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+            )
+            log_f.close()
+            procs.append((pid, p, result_path, log_path))
+
+        def read_log(path: str) -> str:
+            try:
+                with open(path) as f:
+                    return f.read()[-4000:]
+            except OSError:
+                return "<no log>"
+
+        results: List[Any] = [None] * self.num_processes
+        failures = []
+        for pid, p, result_path, log_path in procs:
+            try:
+                p.wait(timeout=self.timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                failures.append(
+                    (pid, f"timeout after {self.timeout_s}s\n{read_log(log_path)}")
+                )
+                continue
+            try:
+                with open(result_path, "rb") as f:
+                    status, value = pickle.load(f)
+            except FileNotFoundError:
+                failures.append(
+                    (
+                        pid,
+                        f"exit code {p.returncode}, no result file\n"
+                        f"{read_log(log_path)}",
+                    )
+                )
+                continue
+            if status == "ok" and p.returncode == 0:
+                results[pid] = value
+            else:
+                failures.append((pid, f"worker exception: {value}"))
+        if failures:
+            # Kill any stragglers before reporting.
+            for _, p, _, _ in procs:
+                if p.poll() is None:
+                    p.kill()
+            detail = "\n---\n".join(f"[process {pid}] {msg}" for pid, msg in failures)
+            raise RuntimeError(
+                f"TpuDistributor: {len(failures)}/{self.num_processes} "
+                f"worker(s) failed:\n{detail}"
+            )
+        return results
