@@ -2,8 +2,9 @@
 
 The CV training workload the reference lineage runs through
 HorovodRunner/Lightning on GPU clusters, as a single-process TPU run.
-With no network egress, data is the learnable synthetic CIFAR-shaped
-stream (the Parquet converter in tpudl.data feeds real datasets).
+--data-dir points at a CIFAR-schema Parquet dataset fed through the
+converter layer (pass --materialize to generate a synthetic one there
+first); without it, an in-memory synthetic stream is used.
 
 Run: python notebooks/cv/train_cifar10.py [--steps N]
 """
@@ -34,7 +35,13 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=200)
     parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--data-dir", type=str, default=None,
+                        help="CIFAR-schema Parquet dataset directory")
+    parser.add_argument("--materialize", action="store_true",
+                        help="generate a synthetic dataset into --data-dir first")
     args = parser.parse_args()
+    if args.materialize and not args.data_dir:
+        parser.error("--materialize requires --data-dir")
 
     cfg = get_config("cifar10_resnet18")
     batch_size = args.batch or cfg.global_batch_size
@@ -51,13 +58,28 @@ def main():
         make_classification_train_step(cfg.label_smoothing), mesh, state, None
     )
 
-    batches = synthetic_classification_batches(
-        batch_size,
-        image_shape=(cfg.image_size, cfg.image_size, 3),
-        num_classes=cfg.num_classes,
-        seed=cfg.seed,
-        num_batches=args.steps,
-    )
+    if args.data_dir:
+        from tpudl.data.converter import make_converter, prefetch_to_device
+        from tpudl.data.datasets import materialize_cifar10_like, normalize_cifar_batch
+
+        if args.materialize:
+            conv = materialize_cifar10_like(args.data_dir, num_rows=50_000)
+        else:
+            conv = make_converter(args.data_dir)
+        raw = conv.make_batch_iterator(
+            batch_size, epochs=None, shuffle=True, seed=cfg.seed
+        )
+        batches = prefetch_to_device(
+            (normalize_cifar_batch(b) for b in raw), mesh=mesh
+        )
+    else:
+        batches = synthetic_classification_batches(
+            batch_size,
+            image_shape=(cfg.image_size, cfg.image_size, 3),
+            num_classes=cfg.num_classes,
+            seed=cfg.seed,
+            num_batches=args.steps,
+        )
     rng = jax.random.key(cfg.seed + 1)
 
     def log(i, metrics):

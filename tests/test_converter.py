@@ -1,0 +1,147 @@
+"""Parquet converter: Petastorm-contract semantics (SURVEY.md §7.4 hard
+part #2 — converter sharding/batching/epochs over pyarrow, no Spark)."""
+
+import numpy as np
+import pytest
+
+from tpudl.data.converter import make_converter, prefetch_to_device, write_parquet
+
+
+@pytest.fixture(scope="module")
+def dataset_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pq")
+    rng = np.random.default_rng(0)
+    write_parquet(
+        str(d),
+        {
+            "image": rng.normal(size=(1000, 8, 8, 3)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(1000,)).astype(np.int64),
+            "idx": np.arange(1000, dtype=np.int64),
+        },
+        rows_per_file=256,
+    )
+    return str(d)
+
+
+def test_row_count_and_files(dataset_dir):
+    conv = make_converter(dataset_dir)
+    assert len(conv) == 1000
+    assert len(conv.files) == 4  # ceil(1000/256)
+
+
+def test_tensor_shape_restored(dataset_dir):
+    conv = make_converter(dataset_dir)
+    batch = next(conv.make_batch_iterator(32, shard_index=0, num_shards=1))
+    assert batch["image"].shape == (32, 8, 8, 3)
+    assert batch["image"].dtype == np.float32
+    assert batch["label"].shape == (32,)
+
+
+def test_epoch_covers_all_rows_once(dataset_dir):
+    conv = make_converter(dataset_dir)
+    seen = []
+    for batch in conv.make_batch_iterator(
+        50, epochs=1, shard_index=0, num_shards=1, drop_last=False
+    ):
+        seen.extend(batch["idx"].tolist())
+    assert sorted(seen) == list(range(1000))
+
+
+def test_shards_disjoint_and_cover(dataset_dir):
+    conv = make_converter(dataset_dir)
+    shards = []
+    for s in range(4):
+        rows = []
+        for batch in conv.make_batch_iterator(
+            10, epochs=1, shard_index=s, num_shards=4, drop_last=False
+        ):
+            rows.extend(batch["idx"].tolist())
+        shards.append(set(rows))
+    union = set().union(*shards)
+    assert union == set(range(1000))
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert not (shards[a] & shards[b])
+
+
+def test_drop_last(dataset_dir):
+    conv = make_converter(dataset_dir)
+    batches = list(
+        conv.make_batch_iterator(64, epochs=1, shard_index=0, num_shards=1)
+    )
+    assert all(len(b["label"]) == 64 for b in batches)
+    # 1000 rows, batch 64: 15 full batches when carrying remainders across files
+    assert len(batches) == 15
+
+
+def test_multiple_epochs(dataset_dir):
+    conv = make_converter(dataset_dir)
+    batches = list(
+        conv.make_batch_iterator(100, epochs=2, shard_index=0, num_shards=1)
+    )
+    assert len(batches) == 20
+
+
+def test_shuffle_determinism(dataset_dir):
+    conv = make_converter(dataset_dir)
+
+    def first_ids(seed):
+        it = conv.make_batch_iterator(
+            32, shuffle=True, seed=seed, shard_index=0, num_shards=1
+        )
+        return next(it)["idx"].tolist()
+
+    assert first_ids(7) == first_ids(7)
+    assert first_ids(7) != first_ids(8)
+    # shuffled epoch still covers everything
+    seen = []
+    for b in conv.make_batch_iterator(
+        50, shuffle=True, seed=3, epochs=1, shard_index=0, num_shards=1,
+        drop_last=False,
+    ):
+        seen.extend(b["idx"].tolist())
+    assert sorted(seen) == list(range(1000))
+
+
+def test_column_selection(dataset_dir):
+    conv = make_converter(dataset_dir)
+    batch = next(
+        conv.make_batch_iterator(
+            16, shard_index=0, num_shards=1, columns=("label",)
+        )
+    )
+    assert set(batch.keys()) == {"label"}
+
+
+def test_prefetch_to_device_mesh(dataset_dir, mesh8):
+    conv = make_converter(dataset_dir)
+    it = conv.make_batch_iterator(64, epochs=1, shard_index=0, num_shards=1)
+    count = 0
+    for batch in prefetch_to_device(it, mesh=mesh8, prefetch=2):
+        assert batch["image"].shape == (64, 8, 8, 3)
+        # global array sharded over the batch axes
+        assert batch["image"].sharding.spec[0] == ("dp", "fsdp")
+        count += 1
+    assert count == 15
+
+
+def test_prefetch_propagates_errors(mesh8):
+    def bad_iter():
+        yield {"x": np.ones((4,), np.float32)}
+        raise RuntimeError("reader exploded")
+
+    it = prefetch_to_device(bad_iter(), mesh=None)
+    next(it)
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        list(it)
+
+
+def test_bad_shard_index(dataset_dir):
+    conv = make_converter(dataset_dir)
+    with pytest.raises(ValueError, match="shard_index"):
+        next(conv.make_batch_iterator(8, shard_index=4, num_shards=4))
+
+
+def test_missing_dir_error(tmp_path):
+    with pytest.raises((ValueError, FileNotFoundError)):
+        make_converter(str(tmp_path / "nope"))

@@ -1,3 +1,9 @@
 """L1 data layer: Parquet converter + dataset helpers."""
 
+from tpudl.data.converter import (  # noqa: F401
+    Converter,
+    make_converter,
+    prefetch_to_device,
+    write_parquet,
+)
 from tpudl.data.synthetic import synthetic_classification_batches  # noqa: F401

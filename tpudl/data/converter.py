@@ -1,0 +1,289 @@
+"""Petastorm-style Parquet converter feeding JAX.
+
+The reference lineage's data layer is Petastorm + Delta through
+`make_spark_converter` readers (BASELINE.json `north_star`; nothing exists
+in the reference tree itself — SURVEY.md §0). This module reproduces the
+converter contract over plain Parquet via pyarrow (petastorm/pyspark are
+not installed here — SURVEY.md §7.1): epoch iteration, batch assembly,
+shard-by-process, shuffle, and device prefetch — without a Spark cluster.
+
+Semantics mirrored from the Petastorm converter:
+- a converter wraps a materialized dataset (Parquet dir) and yields
+  epoch-bounded batch iterators;
+- every JAX process reads only its shard (default: shard by
+  jax.process_index() over jax.process_count());
+- batches are dicts of stacked numpy arrays, ready for device_put.
+
+Tensor columns: fixed-shape arrays are stored as FixedSizeList columns with
+the shape recorded in field metadata (key b"shape"), the same trick
+Petastorm's Unischema codecs use over plain Parquet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    HAVE_PYARROW = True
+except ImportError:  # pragma: no cover
+    HAVE_PYARROW = False
+
+
+# ---------------------------------------------------------------------------
+# Writing (test/example fixture generation; the "Delta table" stand-in).
+# ---------------------------------------------------------------------------
+
+
+def write_parquet(
+    directory: str,
+    columns: Dict[str, np.ndarray],
+    rows_per_file: int = 4096,
+) -> List[str]:
+    """Write a dict of equal-length arrays as a multi-file Parquet dataset.
+
+    Multi-dim arrays become FixedSizeList columns with their per-row shape
+    stored in field metadata, so readers can restore the tensors.
+    """
+    if not HAVE_PYARROW:
+        raise RuntimeError("pyarrow is required for the Parquet data layer")
+    os.makedirs(directory, exist_ok=True)
+    n = None
+    for name, arr in columns.items():
+        if n is None:
+            n = len(arr)
+        elif len(arr) != n:
+            raise ValueError(f"column {name} length {len(arr)} != {n}")
+    assert n is not None
+
+    fields = []
+    flat_cols = {}
+    for name, arr in columns.items():
+        arr = np.asarray(arr)
+        if arr.ndim == 1:
+            pa_arr = pa.array(arr)
+            fields.append(pa.field(name, pa_arr.type))
+            flat_cols[name] = pa_arr
+        else:
+            row_shape = arr.shape[1:]
+            size = int(np.prod(row_shape))
+            flat = arr.reshape(len(arr), size)
+            pa_arr = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.ravel()), size
+            )
+            meta = {b"shape": json.dumps(list(row_shape)).encode()}
+            fields.append(pa.field(name, pa_arr.type, metadata=meta))
+            flat_cols[name] = pa_arr
+
+    schema = pa.schema(fields)
+    table = pa.Table.from_arrays([flat_cols[f.name] for f in fields], schema=schema)
+    paths = []
+    for i, start in enumerate(range(0, n, rows_per_file)):
+        chunk = table.slice(start, rows_per_file)
+        path = os.path.join(directory, f"part-{i:05d}.parquet")
+        pq.write_table(chunk, path)
+        paths.append(path)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# Reading.
+# ---------------------------------------------------------------------------
+
+
+def _decode_table(table) -> Dict[str, np.ndarray]:
+    """Arrow table -> dict of numpy arrays, restoring tensor shapes."""
+    out = {}
+    for i, name in enumerate(table.schema.names):
+        field = table.schema.field(i)
+        col = table.column(i)
+        if pa.types.is_fixed_size_list(field.type):
+            size = field.type.list_size
+            values = col.combine_chunks().values.to_numpy(zero_copy_only=False)
+            arr = values.reshape(len(table), size)
+            if field.metadata and b"shape" in field.metadata:
+                row_shape = json.loads(field.metadata[b"shape"].decode())
+                arr = arr.reshape(len(table), *row_shape)
+            out[name] = arr
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+@dataclasses.dataclass
+class Converter:
+    """A Petastorm-`make_spark_converter`-style handle over a Parquet dir."""
+
+    files: List[str]
+    num_rows: int
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def make_batch_iterator(
+        self,
+        batch_size: int,
+        epochs: Optional[int] = 1,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        shard_index: Optional[int] = None,
+        num_shards: Optional[int] = None,
+        columns: Optional[Sequence[str]] = None,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield batches for this process's shard.
+
+        epochs=None iterates forever. Rows are sharded by index
+        (round-robin over row blocks) so shards are disjoint and their
+        union covers the dataset; defaults come from the JAX process
+        topology exactly like Petastorm's cur_shard/shard_count.
+        """
+        if shard_index is None or num_shards is None:
+            import jax
+
+            shard_index = jax.process_index() if shard_index is None else shard_index
+            num_shards = jax.process_count() if num_shards is None else num_shards
+        if not (0 <= shard_index < num_shards):
+            raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            rng = np.random.default_rng(seed + epoch) if shuffle else None
+            yield from self._epoch_batches(
+                batch_size, rng, shard_index, num_shards, drop_last, columns
+            )
+            epoch += 1
+
+    def _epoch_batches(
+        self, batch_size, rng, shard_index, num_shards, drop_last, columns
+    ):
+        file_order = list(range(len(self.files)))
+        if rng is not None:
+            rng.shuffle(file_order)
+        carry: Optional[Dict[str, np.ndarray]] = None
+        for fi in file_order:
+            table = pq.read_table(self.files[fi], columns=list(columns) if columns else None)
+            data = _decode_table(table)
+            n = len(table)
+            # Round-robin row sharding within the file keeps shards disjoint
+            # regardless of file count vs process count.
+            idx = np.arange(shard_index, n, num_shards)
+            if rng is not None:
+                rng.shuffle(idx)
+            shard = {k: v[idx] for k, v in data.items()}
+            if carry is not None:
+                shard = {
+                    k: np.concatenate([carry[k], shard[k]]) for k in shard
+                }
+            m = len(next(iter(shard.values()))) if shard else 0
+            full = (m // batch_size) * batch_size
+            for start in range(0, full, batch_size):
+                yield {k: v[start : start + batch_size] for k, v in shard.items()}
+            carry = {k: v[full:] for k, v in shard.items()} if full < m else None
+        if carry is not None and not drop_last:
+            m = len(next(iter(carry.values())))
+            if m:
+                yield carry
+
+    def steps_per_epoch(self, batch_size: int, num_shards: Optional[int] = None) -> int:
+        if num_shards is None:
+            import jax
+
+            num_shards = jax.process_count()
+        return (self.num_rows // num_shards) // batch_size
+
+
+def make_converter(source: str | Sequence[str]) -> Converter:
+    """Build a Converter from a Parquet directory or explicit file list
+    (the make_spark_converter analog; the "Delta table" is the Parquet dir)."""
+    if not HAVE_PYARROW:
+        raise RuntimeError("pyarrow is required for the Parquet data layer")
+    if isinstance(source, str):
+        if os.path.isdir(source):
+            files = sorted(
+                os.path.join(source, f)
+                for f in os.listdir(source)
+                if f.endswith(".parquet")
+            )
+        elif os.path.isfile(source):
+            files = [source]
+        else:
+            raise FileNotFoundError(
+                f"{source!r} is neither a Parquet directory nor a file"
+            )
+    else:
+        files = list(source)
+    if not files:
+        raise ValueError(f"no parquet files found in {source!r}")
+    num_rows = sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+    return Converter(files=files, num_rows=num_rows)
+
+
+# ---------------------------------------------------------------------------
+# Device prefetch.
+# ---------------------------------------------------------------------------
+
+
+def prefetch_to_device(
+    iterator: Iterator[Dict[str, np.ndarray]],
+    mesh=None,
+    prefetch: int = 2,
+) -> Iterator[Dict]:
+    """Overlap host batch assembly + H2D transfer with device compute.
+
+    A background thread stages up to `prefetch` batches onto the devices.
+    With a mesh, each process's local batch becomes its addressable shard of
+    a global array sharded over the (dp, fsdp) batch axes
+    (jax.make_array_from_process_local_data — the multi-host feeding path);
+    without one, plain device_put.
+    """
+    import jax
+
+    sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from tpudl.runtime.mesh import batch_partition_spec
+
+        sharding = NamedSharding(mesh, batch_partition_spec())
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+    _SENTINEL = object()
+    errors: List[BaseException] = []
+
+    def put(batch):
+        if sharding is not None:
+            return {
+                k: jax.make_array_from_process_local_data(sharding, v)
+                for k, v in batch.items()
+            }
+        return jax.device_put(batch)
+
+    def worker():
+        try:
+            for batch in iterator:
+                q.put(put(batch))
+        except BaseException as e:  # propagate to consumer
+            errors.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            if errors:
+                raise errors[0]
+            return
+        yield item

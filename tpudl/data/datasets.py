@@ -1,0 +1,84 @@
+"""Dataset helpers: materialize CIFAR-10 / SST-2-shaped data as Parquet.
+
+Zero-egress environment: these write synthetic datasets with the real
+schemas (CIFAR-10: 32x32x3 uint8 + label; SST-2: token ids + mask + label)
+so the full Parquet->converter->device pipeline is exercised end-to-end.
+Drop real exports of the same schema into the directory and everything
+downstream is unchanged — that is the Petastorm/Delta contract
+(BASELINE.json `north_star`).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from tpudl.data.converter import make_converter, write_parquet
+
+
+def materialize_cifar10_like(
+    directory: str,
+    num_rows: int = 10_000,
+    num_classes: int = 10,
+    seed: int = 0,
+    rows_per_file: int = 2048,
+):
+    """CIFAR-10-schema Parquet dataset (image uint8 HWC, int64 label) with a
+    learnable low-frequency class signal (same construction as
+    tpudl.data.synthetic)."""
+    rng = np.random.default_rng(seed)
+    coarse = rng.normal(size=(num_classes, 4, 4, 3)).astype(np.float32)
+    pattern = np.repeat(np.repeat(coarse, 8, axis=1), 8, axis=2)
+    pattern /= np.abs(pattern).max()
+    labels = rng.integers(0, num_classes, size=(num_rows,))
+    noise = rng.normal(0.0, 0.15, size=(num_rows, 32, 32, 3)).astype(np.float32)
+    images = 0.5 + 0.35 * pattern[labels] + noise
+    images_u8 = (np.clip(images, 0.0, 1.0) * 255).astype(np.uint8)
+    write_parquet(
+        directory,
+        {"image": images_u8, "label": labels.astype(np.int64)},
+        rows_per_file=rows_per_file,
+    )
+    return make_converter(directory)
+
+
+def materialize_sst2_like(
+    directory: str,
+    num_rows: int = 8_192,
+    seq_len: int = 128,
+    vocab_size: int = 30_522,  # BERT wordpiece vocab size
+    seed: int = 0,
+    rows_per_file: int = 2048,
+):
+    """SST-2-schema Parquet dataset (input_ids, attention_mask, label) where
+    sentiment is signalled by marker-token frequency (attention-learnable)."""
+    rng = np.random.default_rng(seed)
+    markers = rng.integers(1000, vocab_size, size=(2,))
+    labels = rng.integers(0, 2, size=(num_rows,))
+    ids = rng.integers(1000, vocab_size, size=(num_rows, seq_len))
+    lengths = rng.integers(seq_len // 4, seq_len + 1, size=(num_rows,))
+    mask = (np.arange(seq_len)[None, :] < lengths[:, None]).astype(np.int64)
+    for i in range(num_rows):
+        pos = rng.integers(1, max(lengths[i], 2), size=(max(int(lengths[i]) // 8, 1),))
+        ids[i, pos] = markers[labels[i]]
+    ids[:, 0] = 101  # [CLS]
+    ids = np.where(mask.astype(bool), ids, 0)
+    write_parquet(
+        directory,
+        {
+            "input_ids": ids.astype(np.int64),
+            "attention_mask": mask,
+            "label": labels.astype(np.int64),
+        },
+        rows_per_file=rows_per_file,
+    )
+    return make_converter(directory)
+
+
+def normalize_cifar_batch(batch: dict) -> dict:
+    """uint8 HWC -> float32 normalized, keeping other columns."""
+    out = dict(batch)
+    out["image"] = (batch["image"].astype(np.float32) / 255.0 - 0.5) / 0.25
+    out["label"] = batch["label"].astype(np.int32)
+    return out

@@ -33,6 +33,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 
@@ -72,12 +73,16 @@ class TpuDistributor:
         """Bring up jax.distributed on a pod (idempotent).
 
         Each host of the slice runs the same program and calls this once
-        before any device use; coordinator/process_id auto-detect from the
-        TPU environment.
+        BEFORE any other JAX call (backend init must not have happened yet);
+        coordinator/process_id auto-detect from the TPU environment.
         """
         import jax
 
-        if jax.process_count() > 1:
+        # Idempotence check without touching the backend: consult the
+        # distributed client state rather than jax.process_count(), which
+        # would itself initialize XLA and poison initialize().
+        state = getattr(jax.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
             return
         try:
             if self.coordinator_address:
@@ -185,10 +190,19 @@ class TpuDistributor:
 
         results: List[Any] = [None] * self.num_processes
         failures = []
+        # One shared deadline: after the first timeout every peer blocked on
+        # a collective with the dead worker is killed promptly instead of
+        # burning its own full timeout_s.
+        deadline = time.monotonic() + self.timeout_s
+        timed_out = False
         for pid, p, result_path, log_path in procs:
+            remaining = deadline - time.monotonic()
+            if timed_out or remaining <= 0:
+                remaining = 5.0  # short grace for peers of a dead worker
             try:
-                p.wait(timeout=self.timeout_s)
+                p.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
+                timed_out = True
                 p.kill()
                 p.wait()
                 failures.append(
@@ -209,6 +223,14 @@ class TpuDistributor:
                 continue
             if status == "ok" and p.returncode == 0:
                 results[pid] = value
+            elif status == "ok":
+                failures.append(
+                    (
+                        pid,
+                        f"worker returned a result but exited with code "
+                        f"{p.returncode}\n{read_log(log_path)}",
+                    )
+                )
             else:
                 failures.append((pid, f"worker exception: {value}"))
         if failures:
