@@ -1,0 +1,311 @@
+"""Flax BERT: encoder, pooler, classification head, HF weight import.
+
+The reference declares an NLP workload family but ships nothing in it
+(reference notebooks/nlp/README.md is empty — SURVEY.md §0); the concrete
+workloads come from BASELINE.json: BERT-base SST-2 fine-tune (configs[1]),
+BERT-large multi-host (configs[3]). This is a first-party TPU-native
+implementation, not a port of HF's torch modeling code:
+
+- bf16 compute / f32 params, f32 softmax and LayerNorm;
+- attention flows through tpudl.ops.attend so flash/ring kernels and
+  sequence parallelism drop in without model changes;
+- activation sharding constraints on the (dp,fsdp) x sp x tp mesh axes at
+  block boundaries;
+- optional per-layer rematerialization (jax.checkpoint) to trade FLOPs for
+  HBM on long sequences;
+- `params_from_hf_bert` maps a HuggingFace torch state_dict onto the
+  parameter tree (transpose Linear kernels, rename LayerNorm), so HF
+  checkpoints fine-tune here directly — SURVEY.md §7.4 hard part #3.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from functools import partial
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudl.ops.attention import attend, padding_mask
+from tpudl.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    num_labels: int = 2
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "reference"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+BERT_TINY = partial(BertConfig, hidden_size=128, num_layers=2, num_heads=2,
+                    intermediate_size=512)
+BERT_BASE = BertConfig
+BERT_LARGE = partial(BertConfig, hidden_size=1024, num_layers=24, num_heads=16,
+                     intermediate_size=4096)
+
+
+def _dense(cfg: BertConfig, features: int, name: str) -> nn.Dense:
+    return nn.Dense(
+        features,
+        dtype=cfg.dtype,
+        kernel_init=nn.initializers.normal(0.02),
+        name=name,
+    )
+
+
+class BertEmbeddings(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, train: bool):
+        cfg = self.cfg
+        we = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                      embedding_init=nn.initializers.normal(0.02),
+                      name="word_embeddings")(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        pe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                      embedding_init=nn.initializers.normal(0.02),
+                      name="position_embeddings")(pos)
+        te = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                      embedding_init=nn.initializers.normal(0.02),
+                      name="token_type_embeddings")(token_type_ids)
+        x = we + pe + te
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="layer_norm")(x)
+        x = nn.Dropout(cfg.hidden_dropout, deterministic=not train)(x)
+        return x.astype(cfg.dtype)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask, train: bool):
+        cfg = self.cfg
+        B, S, _ = hidden.shape
+        shape = (B, S, cfg.num_heads, cfg.head_dim)
+        q = _dense(cfg, cfg.hidden_size, "query")(hidden).reshape(shape)
+        k = _dense(cfg, cfg.hidden_size, "key")(hidden).reshape(shape)
+        v = _dense(cfg, cfg.hidden_size, "value")(hidden).reshape(shape)
+        q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
+        k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
+        v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
+        ctx = attend(q, k, v, mask=attn_mask, implementation=cfg.attention_impl)
+        ctx = ctx.reshape(B, S, cfg.hidden_size)
+        out = _dense(cfg, cfg.hidden_size, "out")(ctx)
+        out = nn.Dropout(cfg.hidden_dropout, deterministic=not train)(out)
+        return out
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask, train: bool):
+        cfg = self.cfg
+        attn_out = BertSelfAttention(cfg, name="attention")(
+            hidden, attn_mask, train
+        )
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="attention_norm"
+        )(hidden + attn_out).astype(cfg.dtype)
+
+        inter = _dense(cfg, cfg.intermediate_size, "intermediate")(hidden)
+        inter = nn.gelu(inter, approximate=False)
+        out = _dense(cfg, cfg.hidden_size, "output")(inter)
+        out = nn.Dropout(cfg.hidden_dropout, deterministic=not train)(out)
+        hidden = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="output_norm"
+        )(hidden + out).astype(cfg.dtype)
+        hidden = constrain(hidden, ("dp", "fsdp"), "sp", "tp")
+        return hidden
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask, train: bool):
+        layer_cls = BertLayer
+        if self.cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(self.cfg.num_layers):
+            hidden = layer_cls(self.cfg, name=f"layer_{i}")(
+                hidden, attn_mask, train
+            )
+        return hidden
+
+
+class BertModel(nn.Module):
+    """Encoder + pooler ([CLS] tanh projection), HF-compatible structure."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        train: bool = False,
+    ):
+        cfg = self.cfg
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = BertEmbeddings(cfg, name="embeddings")(input_ids, token_type_ids, train)
+        x = constrain(x, ("dp", "fsdp"), "sp", "tp")
+        mask = padding_mask(attention_mask)
+        x = BertEncoder(cfg, name="encoder")(x, mask, train)
+        pooled = _dense(cfg, cfg.hidden_size, "pooler")(x[:, 0])
+        pooled = jnp.tanh(pooled)
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Module):
+    """The configs[1]/configs[3] fine-tune model (SST-2-style)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        train: bool = False,
+    ):
+        _, pooled = BertModel(self.cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, train
+        )
+        pooled = nn.Dropout(self.cfg.hidden_dropout, deterministic=not train)(
+            pooled
+        )
+        logits = nn.Dense(
+            self.cfg.num_labels,
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.02),
+            name="classifier",
+        )(pooled)
+        return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace weight import (torch state_dict -> tpudl param tree).
+# ---------------------------------------------------------------------------
+
+#: HF name pattern -> tpudl path template. Linear weights transpose
+#: ([out,in] -> [in,out]); embeddings and LayerNorm keep orientation.
+_HF_MAP = [
+    (r"^bert\.embeddings\.word_embeddings\.weight$",
+     "bert/embeddings/word_embeddings/embedding", False),
+    (r"^bert\.embeddings\.position_embeddings\.weight$",
+     "bert/embeddings/position_embeddings/embedding", False),
+    (r"^bert\.embeddings\.token_type_embeddings\.weight$",
+     "bert/embeddings/token_type_embeddings/embedding", False),
+    (r"^bert\.embeddings\.LayerNorm\.weight$",
+     "bert/embeddings/layer_norm/scale", False),
+    (r"^bert\.embeddings\.LayerNorm\.bias$",
+     "bert/embeddings/layer_norm/bias", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.self\.(query|key|value)\.weight$",
+     "bert/encoder/layer_{0}/attention/{1}/kernel", True),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.self\.(query|key|value)\.bias$",
+     "bert/encoder/layer_{0}/attention/{1}/bias", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.dense\.weight$",
+     "bert/encoder/layer_{0}/attention/out/kernel", True),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.dense\.bias$",
+     "bert/encoder/layer_{0}/attention/out/bias", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.weight$",
+     "bert/encoder/layer_{0}/attention_norm/scale", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.bias$",
+     "bert/encoder/layer_{0}/attention_norm/bias", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.intermediate\.dense\.weight$",
+     "bert/encoder/layer_{0}/intermediate/kernel", True),
+    (r"^bert\.encoder\.layer\.(\d+)\.intermediate\.dense\.bias$",
+     "bert/encoder/layer_{0}/intermediate/bias", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.dense\.weight$",
+     "bert/encoder/layer_{0}/output/kernel", True),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.dense\.bias$",
+     "bert/encoder/layer_{0}/output/bias", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.LayerNorm\.weight$",
+     "bert/encoder/layer_{0}/output_norm/scale", False),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.LayerNorm\.bias$",
+     "bert/encoder/layer_{0}/output_norm/bias", False),
+    (r"^bert\.pooler\.dense\.weight$", "bert/pooler/kernel", True),
+    (r"^bert\.pooler\.dense\.bias$", "bert/pooler/bias", False),
+    (r"^classifier\.weight$", "classifier/kernel", True),
+    (r"^classifier\.bias$", "classifier/bias", False),
+]
+
+
+def params_from_hf_bert(
+    state_dict: Dict[str, "np.ndarray"],
+    like: Optional[Dict] = None,
+) -> Dict:
+    """Convert a HF BertForSequenceClassification state_dict to a tpudl
+    param tree. `state_dict` values may be torch tensors or numpy arrays.
+    `like` (a template param tree) enables shape validation.
+
+    Ignored HF keys: position_ids buffers and the cls.* pretraining heads.
+    """
+    tree: Dict = {}
+    unmapped = []
+    for hf_name, value in state_dict.items():
+        arr = np.asarray(getattr(value, "numpy", lambda: value)())
+        for pattern, template, transpose in _HF_MAP:
+            m = re.match(pattern, hf_name)
+            if m:
+                path = template.format(*m.groups())
+                if transpose:
+                    arr = arr.T
+                node = tree
+                parts = path.split("/")
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {})
+                node[parts[-1]] = jnp.asarray(arr)
+                break
+        else:
+            if not (
+                hf_name.endswith("position_ids")
+                or hf_name.startswith("cls.")
+                or ".seq_relationship." in hf_name
+            ):
+                unmapped.append(hf_name)
+    if unmapped:
+        raise ValueError(f"unmapped HF parameters: {unmapped}")
+    if like is not None:
+        flat_like = jax.tree_util.tree_leaves_with_path(like)
+        flat_new = dict(
+            (jax.tree_util.keystr(p), l.shape)
+            for p, l in jax.tree_util.tree_leaves_with_path(tree)
+        )
+        for path, leaf in flat_like:
+            key = jax.tree_util.keystr(path)
+            if key not in flat_new:
+                raise ValueError(f"missing parameter {key} in converted tree")
+            if tuple(flat_new[key]) != tuple(leaf.shape):
+                raise ValueError(
+                    f"shape mismatch at {key}: HF {flat_new[key]} vs "
+                    f"model {leaf.shape}"
+                )
+    return tree

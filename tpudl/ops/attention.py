@@ -1,0 +1,89 @@
+"""Attention ops: the single seam all tpudl transformer models go through.
+
+`dot_product_attention(q, k, v, ...)` is the reference implementation
+(einsum + f32 softmax). `attend()` dispatches by implementation name so
+models can switch to the Pallas flash kernel (tpudl.ops.flash_attention) or
+the ring/sequence-parallel path (tpudl.ops.ring_attention) without touching
+model code. The reference repo has no attention anywhere (its NLP family is
+an empty placeholder — reference notebooks/nlp/README.md, SURVEY.md §5.7);
+this design makes long-context support first-class instead.
+
+Shapes follow the TPU-friendly convention:
+  q, k, v: [batch, seq, heads, head_dim]   (BSHD)
+  mask:    broadcastable to [batch, heads, q_seq, kv_seq], True = attend
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+#: Large negative fill for masked logits, safe in bf16.
+MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference attention: bf16 matmuls on the MXU, softmax in f32.
+
+    q: [B, Sq, H, D]; k, v: [B, Skv, H, D]; returns [B, Sq, H, D].
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, MASK_VALUE)
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = weights.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def causal_mask(q_len: int, kv_len: int) -> jax.Array:
+    """[1, 1, q_len, kv_len] lower-triangular mask (True = attend)."""
+    i = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+    j = jnp.arange(kv_len)[None, :]
+    return (j <= i)[None, None, :, :]
+
+
+def padding_mask(attention_mask: jax.Array) -> jax.Array:
+    """[B, Skv] 1/0 padding mask -> [B, 1, 1, Skv] boolean attend-mask."""
+    return attention_mask[:, None, None, :].astype(bool)
+
+
+def attend(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    implementation: str = "reference",
+    causal: bool = False,
+) -> jax.Array:
+    """Dispatch to an attention implementation.
+
+    implementation:
+      "reference" — this module's einsum attention (any backend);
+      "flash"     — Pallas TPU flash-attention kernel;
+      "ring"      — sequence-parallel ring attention over the `sp` mesh axis.
+    """
+    if causal and mask is None:
+        mask = causal_mask(q.shape[1], k.shape[1])
+    if implementation == "reference":
+        return dot_product_attention(q, k, v, mask)
+    if implementation == "flash":
+        from tpudl.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, mask=mask, causal=causal)
+    if implementation == "ring":
+        from tpudl.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mask=mask, causal=causal)
+    raise ValueError(f"unknown attention implementation: {implementation!r}")

@@ -60,19 +60,25 @@ def cross_entropy_loss(
 
 def make_classification_train_step(
     label_smoothing: float = 0.0,
-    input_key: str = "image",
+    input_keys: "str | tuple" = ("image",),
     label_key: str = "label",
 ) -> Callable:
     """Train step for image/sequence classification models.
+
+    `input_keys` name the batch columns passed positionally to the model —
+    ("image",) for CV, ("input_ids", "attention_mask") for BERT-style.
 
     Works with or without BatchNorm state. All reductions (loss mean, batch
     statistics) have global semantics under pjit: with the batch sharded
     over (dp, fsdp) they compile to ICI collectives — synchronized BN and
     gradient all-reduce with zero framework code.
     """
+    if isinstance(input_keys, str):
+        input_keys = (input_keys,)
 
     def step(state: TrainState, batch: dict, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)
+        inputs = tuple(batch[k] for k in input_keys)
 
         def loss_fn(params):
             variables = {"params": params}
@@ -80,7 +86,7 @@ def make_classification_train_step(
                 variables["batch_stats"] = state.batch_stats
                 outputs, mutated = state.apply_fn(
                     variables,
-                    batch[input_key],
+                    *inputs,
                     train=True,
                     mutable=["batch_stats"],
                     rngs={"dropout": step_rng},
@@ -88,7 +94,7 @@ def make_classification_train_step(
                 new_stats = mutated["batch_stats"]
             else:
                 outputs = state.apply_fn(
-                    variables, batch[input_key], train=True, rngs={"dropout": step_rng}
+                    variables, *inputs, train=True, rngs={"dropout": step_rng}
                 )
                 new_stats = None
             loss = cross_entropy_loss(outputs, batch[label_key], label_smoothing)
@@ -110,13 +116,18 @@ def make_classification_train_step(
 
 
 def make_classification_eval_step(
-    input_key: str = "image", label_key: str = "label"
+    input_keys: "str | tuple" = ("image",), label_key: str = "label"
 ) -> Callable:
+    if isinstance(input_keys, str):
+        input_keys = (input_keys,)
+
     def step(state: TrainState, batch: dict):
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
-        logits = state.apply_fn(variables, batch[input_key], train=False)
+        logits = state.apply_fn(
+            variables, *(batch[k] for k in input_keys), train=False
+        )
         return {
             "loss": cross_entropy_loss(logits, batch[label_key]),
             "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch[label_key]),
