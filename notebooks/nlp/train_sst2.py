@@ -1,0 +1,152 @@
+"""BERT / SST-2 fine-tune (BASELINE.json configs[1]).
+
+The NLP workload the reference declares but never ships (reference
+notebooks/nlp/README.md is an empty placeholder — SURVEY.md §0), built
+TPU-native: Flax BERT through the attend() seam, Optax AdamW with warmup,
+pjit over the (dp, fsdp, sp, tp) mesh, samples/sec + MFU reported the way
+BASELINE.json `metric`/`north_star` ask.
+
+--data-dir points at an SST-2-schema Parquet dataset fed through the
+converter layer (pass --materialize to generate a synthetic one there
+first); without it, an in-memory synthetic stream is used. In an
+environment with network access, real pretrained weights drop in via
+tpudl.models.params_from_hf_bert on a HuggingFace state_dict (parity
+guaranteed by tests/test_bert.py::test_hf_weight_import_logits_parity).
+
+Run: python notebooks/nlp/train_sst2.py [--steps N] [--model bert-tiny]
+"""
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.config import get_config
+from tpudl.data.synthetic import synthetic_token_batches
+from tpudl.models.registry import build_model
+from tpudl.runtime import make_mesh
+from tpudl.train import (
+    compile_step,
+    create_train_state,
+    fit,
+    make_classification_train_step,
+)
+from tpudl.train.metrics import (
+    compiled_flops,
+    device_peak_flops,
+    mfu,
+    transformer_train_flops,
+)
+from tpudl.train.optim import make_optimizer
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--model", type=str, default=None,
+                        help="override config model (e.g. bert-tiny for smoke)")
+    parser.add_argument("--seq-len", type=int, default=None)
+    parser.add_argument("--data-dir", type=str, default=None,
+                        help="SST-2-schema Parquet dataset directory")
+    parser.add_argument("--materialize", action="store_true",
+                        help="generate a synthetic dataset into --data-dir first")
+    args = parser.parse_args()
+    if args.materialize and not args.data_dir:
+        parser.error("--materialize requires --data-dir")
+
+    cfg = get_config("sst2_bert_base")
+    if args.model:
+        cfg = get_config("sst2_bert_base", model=args.model)
+    batch_size = args.batch or cfg.global_batch_size
+    seq_len = args.seq_len or cfg.seq_len
+
+    model = build_model(cfg.model, cfg.num_classes)
+    sample_ids = jnp.zeros((1, seq_len), jnp.int32)
+    state = create_train_state(
+        jax.random.key(cfg.seed),
+        model,
+        sample_ids,
+        make_optimizer(cfg.optim),
+    )
+    num_params = sum(
+        p.size for p in jax.tree_util.tree_leaves(state.params)
+    )
+    print(f"{cfg.model}: {num_params / 1e6:.1f}M params, batch {batch_size}, "
+          f"seq {seq_len}")
+
+    mesh = make_mesh(cfg.mesh)
+    step = compile_step(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"), label_key="label"
+        ),
+        mesh,
+        state,
+        None,
+    )
+
+    if args.data_dir:
+        from tpudl.data.converter import make_converter, prefetch_to_device
+        from tpudl.data.datasets import materialize_sst2_like, normalize_sst2_batch
+
+        if args.materialize:
+            conv = materialize_sst2_like(
+                args.data_dir, num_rows=8_192, seq_len=seq_len,
+                vocab_size=model.cfg.vocab_size,
+            )
+        else:
+            conv = make_converter(args.data_dir)
+        raw = conv.make_batch_iterator(
+            batch_size, epochs=None, shuffle=True, seed=cfg.seed
+        )
+        batches = prefetch_to_device(
+            (normalize_sst2_batch(b) for b in raw), mesh=mesh
+        )
+    else:
+        batches = synthetic_token_batches(
+            batch_size,
+            seq_len=seq_len,
+            vocab_size=model.cfg.vocab_size,
+            num_classes=cfg.num_classes,
+            seed=cfg.seed,
+            num_batches=args.steps,
+        )
+    rng = jax.random.key(cfg.seed + 1)
+
+    def log(i, metrics):
+        print(f"step {i}: loss {metrics['loss']:.4f} acc {metrics['accuracy']:.3f}")
+
+    state, metrics, info = fit(
+        step, state, batches, rng, num_steps=args.steps,
+        log_every=cfg.log_every, logger=log,
+    )
+    print(f"final: {metrics}")
+
+    samples_per_sec = batch_size * info["steps"] / info["seconds"]
+    # FLOPs from the compiled executable; 6ND transformer estimate as fallback.
+    flops = None
+    try:
+        example = next(synthetic_token_batches(
+            batch_size, seq_len=seq_len, vocab_size=model.cfg.vocab_size,
+            num_batches=1,
+        ))
+        flops = compiled_flops(step.jitted.lower(state, example, rng))
+    except Exception:
+        pass
+    if flops is None:
+        flops = transformer_train_flops(num_params, batch_size * seq_len)
+    step_seconds = info["seconds"] / max(info["steps"], 1)
+    print(
+        f"throughput ~{samples_per_sec:.0f} samples/sec over {info['steps']} "
+        f"steps (includes compile); "
+        f"MFU ~{100 * mfu(flops, step_seconds, jax.device_count()):.1f}% "
+        f"(peak {device_peak_flops() / 1e12:.0f} TFLOP/s/chip)"
+    )
+
+
+if __name__ == "__main__":
+    main()

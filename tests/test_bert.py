@@ -1,0 +1,208 @@
+"""BERT model family tests (BASELINE.json configs[1]/[3]).
+
+The reference's NLP family is an empty placeholder (reference
+notebooks/nlp/README.md); its behavioral signature elsewhere is "load a
+pretrained torch model, verify numerical parity across backends"
+(reference notebooks/cv/onnx_experiments.py:19,142-144). The parity test
+here applies that signature to NLP: a random-init HuggingFace torch
+BertForSequenceClassification (no download — zero egress) is mapped
+through params_from_hf_bert and must reproduce torch logits at f32.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpudl.models.bert import (
+    BertConfig,
+    BertForSequenceClassification,
+    params_from_hf_bert,
+)
+from tpudl.models.registry import build_model
+
+TINY = BertConfig(
+    vocab_size=512,
+    hidden_size=64,
+    num_layers=2,
+    num_heads=2,
+    intermediate_size=128,
+    max_position_embeddings=64,
+    num_labels=2,
+    dtype=jnp.float32,
+)
+
+
+def _batch(rng, batch=4, seq=16, vocab=512):
+    ids = rng.integers(5, vocab, size=(batch, seq)).astype(np.int32)
+    lengths = rng.integers(seq // 2, seq + 1, size=(batch,))
+    mask = (np.arange(seq)[None, :] < lengths[:, None]).astype(np.int32)
+    ids = np.where(mask.astype(bool), ids, 0)
+    return ids, mask
+
+
+def test_forward_shapes_and_dtype(rng_np):
+    model = BertForSequenceClassification(TINY)
+    ids, mask = _batch(rng_np)
+    variables = model.init(jax.random.key(0), ids, mask)
+    logits = model.apply(variables, ids, mask)
+    assert logits.shape == (4, TINY.num_labels)
+    assert logits.dtype == jnp.float32
+
+
+def test_bf16_compute_f32_params(rng_np):
+    cfg = BertConfig(
+        vocab_size=512, hidden_size=64, num_layers=1, num_heads=2,
+        intermediate_size=128, max_position_embeddings=64,
+    )
+    assert cfg.dtype == jnp.bfloat16
+    model = BertForSequenceClassification(cfg)
+    ids, mask = _batch(rng_np)
+    variables = model.init(jax.random.key(0), ids, mask)
+    # Params stay f32 (master weights); logits come back f32.
+    leaves = jax.tree_util.tree_leaves(variables["params"])
+    assert all(l.dtype == jnp.float32 for l in leaves)
+    logits = model.apply(variables, ids, mask)
+    assert logits.dtype == jnp.float32
+
+
+def test_registry_builds_bert():
+    model = build_model("bert-tiny", num_classes=3)
+    assert isinstance(model, BertForSequenceClassification)
+    assert model.cfg.num_labels == 3
+    assert model.cfg.hidden_size == 128
+    base = build_model("bert-base", num_classes=2)
+    assert base.cfg.hidden_size == 768 and base.cfg.num_layers == 12
+    large = build_model("bert-large", num_classes=2)
+    assert large.cfg.hidden_size == 1024 and large.cfg.num_layers == 24
+
+
+def test_hf_weight_import_logits_parity(rng_np):
+    """params_from_hf_bert must reproduce HF torch logits exactly (f32).
+
+    Random-init torch model, no download; defends against silent transpose /
+    LayerNorm-placement bugs (SURVEY.md §7.4 hard part #3)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=TINY.vocab_size,
+        hidden_size=TINY.hidden_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        intermediate_size=TINY.intermediate_size,
+        max_position_embeddings=TINY.max_position_embeddings,
+        num_labels=TINY.num_labels,
+        hidden_act="gelu",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.BertForSequenceClassification(hf_cfg).eval()
+
+    model = BertForSequenceClassification(TINY)
+    ids, mask = _batch(rng_np, batch=3, seq=24)
+    template = model.init(jax.random.key(0), ids, mask)["params"]
+    params = params_from_hf_bert(
+        {k: v.detach().numpy() for k, v in hf_model.state_dict().items()},
+        like=template,
+    )
+
+    with torch.no_grad():
+        torch_logits = hf_model(
+            input_ids=torch.from_numpy(np.asarray(ids, np.int64)),
+            attention_mask=torch.from_numpy(np.asarray(mask, np.int64)),
+        ).logits.numpy()
+    jax_logits = np.asarray(model.apply({"params": params}, ids, mask))
+    np.testing.assert_allclose(jax_logits, torch_logits, rtol=1e-4, atol=2e-5)
+
+
+def test_hf_weight_import_validates_shapes(rng_np):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    hf_cfg = transformers.BertConfig(
+        vocab_size=TINY.vocab_size,
+        hidden_size=TINY.hidden_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        intermediate_size=TINY.intermediate_size,
+        max_position_embeddings=TINY.max_position_embeddings,
+        num_labels=TINY.num_labels,
+    )
+    hf_model = transformers.BertForSequenceClassification(hf_cfg)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+
+    wrong = BertConfig(
+        vocab_size=512, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=128, max_position_embeddings=64, dtype=jnp.float32,
+    )
+    ids, mask = _batch(rng_np)
+    template = BertForSequenceClassification(wrong).init(
+        jax.random.key(0), ids, mask
+    )["params"]
+    with pytest.raises(ValueError, match="shape mismatch"):
+        params_from_hf_bert(sd, like=template)
+
+
+def test_loss_decreases_token_task():
+    """Tiny BERT learns the marker-token synthetic task (SURVEY.md §4.2
+    integration-smoke tier, applied to the NLP vertical)."""
+    from tpudl.data.synthetic import synthetic_token_batches
+    from tpudl.train import (
+        create_train_state,
+        fit,
+        make_classification_train_step,
+    )
+
+    cfg = BertConfig(
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=128,
+        max_position_embeddings=64,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        dtype=jnp.float32,
+    )
+    model = BertForSequenceClassification(cfg)
+    batches = list(
+        synthetic_token_batches(16, seq_len=32, vocab_size=256, num_batches=40)
+    )
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.asarray(batches[0]["input_ids"]),
+        optax.adamw(1e-3),
+        init_kwargs={"train": False},
+    )
+    step = jax.jit(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"), label_key="label"
+        )
+    )
+    first = None
+    rng = jax.random.key(1)
+    for batch in batches:
+        state, metrics = step(state, batch, rng)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first * 0.7, f"loss did not decrease: {first} -> {last}"
+
+
+def test_attention_dropout_active_in_train_mode(rng_np):
+    """Dropout on attention probabilities must change train-mode outputs
+    (ADVICE.md round-1: the config field was silently unused)."""
+    cfg = BertConfig(
+        vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+        intermediate_size=64, max_position_embeddings=32,
+        hidden_dropout=0.0, attention_dropout=0.5, dtype=jnp.float32,
+    )
+    model = BertForSequenceClassification(cfg)
+    ids, mask = _batch(rng_np, batch=2, seq=8, vocab=128)
+    variables = model.init(jax.random.key(0), ids, mask)
+    eval_logits = model.apply(variables, ids, mask, train=False)
+    train_logits = model.apply(
+        variables, ids, mask, train=True, rngs={"dropout": jax.random.key(7)}
+    )
+    assert not np.allclose(np.asarray(eval_logits), np.asarray(train_logits))

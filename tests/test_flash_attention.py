@@ -1,0 +1,174 @@
+"""Flash-attention kernel parity vs the reference einsum implementation.
+
+Runs the actual Pallas kernel in interpreter mode on the CPU backend
+(SURVEY.md §4.2's hermetic tier); the same code compiles for TPU. Parity
+bar follows the reference's cross-backend contract (reference
+notebooks/cv/onnx_experiments.py:142-144): explicit rtol/atol, forward and
+backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudl.ops.attention import (
+    attend,
+    causal_mask,
+    dot_product_attention,
+    padding_mask,
+)
+from tpudl.ops.flash_attention import flash_attention
+
+
+def _qkv(rng, b=2, sq=128, skv=128, h=2, d=64, dtype=jnp.float32):
+    shape = (b, sq, h, d)
+    kshape = (b, skv, h, d)
+    q = jnp.asarray(rng.normal(size=shape), dtype)
+    k = jnp.asarray(rng.normal(size=kshape), dtype)
+    v = jnp.asarray(rng.normal(size=kshape), dtype)
+    return q, k, v
+
+
+def _padding(rng, b, skv):
+    lengths = rng.integers(skv // 2, skv + 1, size=(b,))
+    return (np.arange(skv)[None, :] < lengths[:, None]).astype(np.int32)
+
+
+def test_forward_parity_no_mask(rng_np):
+    q, k, v = _qkv(rng_np)
+    ref = dot_product_attention(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_forward_parity_padding_mask(rng_np):
+    q, k, v = _qkv(rng_np, sq=64, skv=64)
+    mask2d = jnp.asarray(_padding(rng_np, 2, 64))
+    ref = dot_product_attention(q, k, v, mask=padding_mask(mask2d))
+    out = flash_attention(q, k, v, mask=padding_mask(mask2d), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_forward_parity_causal(rng_np):
+    q, k, v = _qkv(rng_np, sq=128, skv=128)
+    ref = dot_product_attention(q, k, v, mask=causal_mask(128, 128))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_forward_parity_causal_unequal_lens(rng_np):
+    """Causal with Sq != Skv must be bottom-right aligned like
+    causal_mask (decode-style: short q window over a long kv history)."""
+    q, k, v = _qkv(rng_np, sq=64, skv=192)
+    ref = dot_product_attention(q, k, v, mask=causal_mask(64, 192))
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, mask=causal_mask(64, 192)) ** 2)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    fl_grads = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, rg, fg in zip("qkv", ref_grads, fl_grads):
+        np.testing.assert_allclose(
+            np.asarray(fg), np.asarray(rg), rtol=1e-4, atol=1e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_forward_unaligned_seq_lens(rng_np):
+    """Sq/Skv not multiples of the tile size exercise the padding path."""
+    q, k, v = _qkv(rng_np, sq=50, skv=70)
+    mask2d = jnp.asarray(_padding(rng_np, 2, 70))
+    ref = dot_product_attention(q, k, v, mask=padding_mask(mask2d))
+    out = flash_attention(q, k, v, mask=padding_mask(mask2d), interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_gradient_parity(rng_np):
+    q, k, v = _qkv(rng_np, sq=64, skv=64)
+    mask2d = jnp.asarray(_padding(rng_np, 2, 64))
+
+    def ref_loss(q, k, v):
+        out = dot_product_attention(q, k, v, mask=padding_mask(mask2d))
+        return jnp.sum(out * out)
+
+    def flash_loss(q, k, v):
+        out = flash_attention(q, k, v, mask=padding_mask(mask2d),
+                              interpret=True)
+        return jnp.sum(out * out)
+
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    fl_grads = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, rg, fg in zip("qkv", ref_grads, fl_grads):
+        np.testing.assert_allclose(
+            np.asarray(fg), np.asarray(rg), rtol=1e-4, atol=1e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_gradient_parity_causal(rng_np):
+    q, k, v = _qkv(rng_np, sq=64, skv=64)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, mask=causal_mask(64, 64)) ** 2)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    ref_grads = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    fl_grads = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, rg, fg in zip("qkv", ref_grads, fl_grads):
+        np.testing.assert_allclose(
+            np.asarray(fg), np.asarray(rg), rtol=1e-4, atol=1e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_bf16_inputs(rng_np):
+    q, k, v = _qkv(rng_np, dtype=jnp.bfloat16)
+    ref = dot_product_attention(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.02,
+    )
+
+
+def test_attend_dispatch_flash(rng_np):
+    q, k, v = _qkv(rng_np, sq=32, skv=32)
+    out = attend(q, k, v, implementation="flash")
+    ref = attend(q, k, v, implementation="reference")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_dense_mask_rejected(rng_np):
+    q, k, v = _qkv(rng_np, sq=16, skv=16)
+    dense = jnp.ones((2, 2, 16, 16), bool)
+    with pytest.raises(NotImplementedError):
+        flash_attention(q, k, v, mask=dense, interpret=True)
+
+
+def test_flash_rejects_attention_dropout(rng_np):
+    q, k, v = _qkv(rng_np, sq=16, skv=16)
+    with pytest.raises(ValueError, match="dropout"):
+        attend(q, k, v, implementation="flash", dropout_rate=0.1,
+               dropout_rng=jax.random.key(0))
+    # A nonzero rate with no rng must also be rejected, not silently dropped.
+    with pytest.raises(ValueError, match="dropout"):
+        attend(q, k, v, implementation="flash", dropout_rate=0.1)

@@ -82,3 +82,12 @@ def normalize_cifar_batch(batch: dict) -> dict:
     out["image"] = (batch["image"].astype(np.float32) / 255.0 - 0.5) / 0.25
     out["label"] = batch["label"].astype(np.int32)
     return out
+
+
+def normalize_sst2_batch(batch: dict) -> dict:
+    """Parquet int64 token columns -> int32 for the device."""
+    return {
+        "input_ids": batch["input_ids"].astype(np.int32),
+        "attention_mask": batch["attention_mask"].astype(np.int32),
+        "label": batch["label"].astype(np.int32),
+    }

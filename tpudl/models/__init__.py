@@ -1,5 +1,14 @@
-"""L2 model families: CV (ResNet) and NLP (BERT, LoRA)."""
+"""L2 model families: CV (ResNet) and NLP (BERT, Llama, LoRA)."""
 
+from tpudl.models.bert import (  # noqa: F401
+    BERT_BASE,
+    BERT_LARGE,
+    BERT_TINY,
+    BertConfig,
+    BertForSequenceClassification,
+    BertModel,
+    params_from_hf_bert,
+)
 from tpudl.models.resnet import (  # noqa: F401
     ResNet,
     ResNet18,

@@ -109,7 +109,18 @@ class BertSelfAttention(nn.Module):
         q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
         k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
         v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
-        ctx = attend(q, k, v, mask=attn_mask, implementation=cfg.attention_impl)
+        attn_dropout_rng = None
+        if train and cfg.attention_dropout > 0.0:
+            attn_dropout_rng = self.make_rng("dropout")
+        ctx = attend(
+            q,
+            k,
+            v,
+            mask=attn_mask,
+            implementation=cfg.attention_impl,
+            dropout_rate=cfg.attention_dropout if train else 0.0,
+            dropout_rng=attn_dropout_rng,
+        )
         ctx = ctx.reshape(B, S, cfg.hidden_size)
         out = _dense(cfg, cfg.hidden_size, "out")(ctx)
         out = nn.Dropout(cfg.hidden_dropout, deterministic=not train)(out)

@@ -6,7 +6,20 @@ from typing import Any
 
 import jax.numpy as jnp
 
+from tpudl.models.bert import (
+    BERT_BASE,
+    BERT_LARGE,
+    BERT_TINY,
+    BertForSequenceClassification,
+)
 from tpudl.models.resnet import ResNet18, ResNet34, ResNet50, ResNet101
+
+#: BertConfig factories by size name (tpudl.models.bert).
+_BERT_SIZES = {
+    "bert-tiny": BERT_TINY,
+    "bert-base": BERT_BASE,
+    "bert-large": BERT_LARGE,
+}
 
 
 def build_model(name: str, num_classes: int, **kwargs: Any):
@@ -20,9 +33,16 @@ def build_model(name: str, num_classes: int, **kwargs: Any):
     }
     if name in cv:
         return cv[name](num_classes=num_classes, dtype=dtype, **kwargs)
-    if name.startswith("bert") or name.startswith("llama"):
-        raise NotImplementedError(
-            f"model '{name}' is scheduled in SURVEY.md §7.3 (NLP family) "
-            "and not built yet"
-        )
+    if name in _BERT_SIZES:
+        cfg = _BERT_SIZES[name](num_labels=num_classes, dtype=dtype, **kwargs)
+        return BertForSequenceClassification(cfg)
+    if name.startswith("llama"):
+        try:
+            from tpudl.models.llama import build_llama
+        except ModuleNotFoundError as e:
+            raise NotImplementedError(
+                f"model {name!r}: the Llama family (BASELINE.json configs[4]) "
+                "is not in this build yet"
+            ) from e
+        return build_llama(name, num_classes=num_classes, dtype=dtype, **kwargs)
     raise ValueError(f"unknown model name: {name!r}")

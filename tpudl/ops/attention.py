@@ -30,10 +30,14 @@ def dot_product_attention(
     v: jax.Array,
     mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference attention: bf16 matmuls on the MXU, softmax in f32.
 
     q: [B, Sq, H, D]; k, v: [B, Skv, H, D]; returns [B, Sq, H, D].
+    ``dropout_rate`` drops attention probabilities (BERT-style) when a
+    ``dropout_rng`` is supplied.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -43,6 +47,11 @@ def dot_product_attention(
         logits = jnp.where(mask, logits, MASK_VALUE)
     weights = jax.nn.softmax(logits, axis=-1)
     weights = weights.astype(v.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0).astype(
+            v.dtype
+        )
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
@@ -66,6 +75,8 @@ def attend(
     *,
     implementation: str = "reference",
     causal: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Dispatch to an attention implementation.
 
@@ -73,11 +84,22 @@ def attend(
       "reference" — this module's einsum attention (any backend);
       "flash"     — Pallas TPU flash-attention kernel;
       "ring"      — sequence-parallel ring attention over the `sp` mesh axis.
+
+    Attention-probability dropout is only supported by the reference
+    implementation; flash/ring reject a nonzero rate rather than silently
+    dropping it (fine-tune with attention_dropout=0 on those paths).
     """
-    if causal and mask is None:
-        mask = causal_mask(q.shape[1], k.shape[1])
     if implementation == "reference":
-        return dot_product_attention(q, k, v, mask)
+        if causal and mask is None:
+            mask = causal_mask(q.shape[1], k.shape[1])
+        return dot_product_attention(
+            q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng
+        )
+    if dropout_rate > 0.0:
+        raise ValueError(
+            f"attention-probability dropout is not supported by the "
+            f"{implementation!r} implementation; set attention_dropout=0.0"
+        )
     if implementation == "flash":
         from tpudl.ops.flash_attention import flash_attention
 

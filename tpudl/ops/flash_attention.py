@@ -1,0 +1,413 @@
+"""Pallas TPU flash attention: fused, memory-linear attention.
+
+The one first-party custom kernel the framework warrants (SURVEY.md §2.2):
+attention is the hot op of the NLP configs (BASELINE.json configs[1,3,4])
+and the naive einsum path materializes the [B, H, Sq, Skv] logits in HBM.
+This kernel never does — the online-softmax recurrence keeps one
+(block_q, block_k) tile in VMEM, both matmuls hit the MXU in the input
+dtype with f32 accumulation, and the backward pass recomputes probability
+tiles from the saved logsumexp instead of storing them (memory O(S), not
+O(S^2)).
+
+Layout: grid (batch, heads, q_blocks, kv_blocks) with the kv axis
+innermost, so Pallas double-buffers the K/V tile stream from HBM while the
+MXU works; running max / denominator / output accumulators live in VMEM
+scratch across kv steps.
+
+Masking: padding masks enter as a [B, Skv] kv-validity row (the BERT
+case), causal masks are generated in-kernel from block indices (the
+decoder case) — neither ever materializes an S×S array. Arbitrary dense
+[B, H, Sq, Skv] masks are not supported here; use the reference
+implementation for those.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpudl.ops.attention import MASK_VALUE
+
+#: Default tile sizes; VPU/MXU-aligned (multiples of the f32 (8,128) tile).
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _interpret_default() -> bool:
+    # Interpret mode on CPU so the same kernel runs in the hermetic test
+    # environment (SURVEY.md §4.2) and compiled on TPU.
+    return jax.default_backend() == "cpu"
+
+
+def _block_sizes(sq: int, skv: int, block_q, block_k):
+    bq = block_q or min(DEFAULT_BLOCK_Q, _round_up(sq, 128))
+    bk = block_k or min(DEFAULT_BLOCK_K, _round_up(skv, 128))
+    return min(bq, _round_up(sq, 128)), min(bk, _round_up(skv, 128))
+
+
+def _tile_contributes(qi, kv, causal, block_q, block_k, causal_offset):
+    """Whether kv tile `kv` can contribute to q tile `qi` (causal skip).
+
+    Causal masking is bottom-right aligned like
+    tpudl.ops.attention.causal_mask: kv_idx <= q_idx + (Skv - Sq)."""
+    if not causal:
+        return True
+    q_end = (qi + 1) * block_q - 1 + causal_offset
+    return kv * block_k <= q_end
+
+
+def _tile_keep(kvm_row, qi, kv, causal, block_q, block_k, causal_offset):
+    """[block_q, block_k] attend-mask for one tile: kv validity row plus the
+    (bottom-right-aligned) causal triangle, generated from indices — never
+    materialized at [Sq, Skv]."""
+    keep = (kvm_row > 0.0)[None, :]
+    if causal:
+        q_ids = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kv_ids = kv * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        keep = jnp.logical_and(keep, kv_ids <= q_ids + causal_offset)
+    return keep
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, causal_offset):
+    qi, kv = pl.program_id(2), pl.program_id(3)
+    nkv = pl.num_programs(3)
+
+    @pl.when(kv == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, MASK_VALUE)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_tile_contributes(qi, kv, causal, block_q, block_k, causal_offset))
+    def _accumulate():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+
+        keep = _tile_keep(kvm_ref[0, 0, :], qi, kv, causal,
+                          block_q, block_k, causal_offset)
+        s = jnp.where(keep, s, MASK_VALUE)
+
+        m_prev = m_scr[:, :1]  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0, :, :],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kv == nkv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0, 0, :] = m_scr[:, 0] + jnp.log(l_safe[:, 0])
+
+
+def _fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    bq, bk = _block_sizes(sq, skv, block_q, block_k)
+    sq_p, skv_p = _round_up(sq, bq), _round_up(skv, bk)
+
+    # BSHD -> BHSD, padded to tile multiples; padded kv is masked off.
+    qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    kt = jnp.pad(k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    vt = jnp.pad(v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    # kv-validity mask as [B, 1, Skv]: the lane-dim layout TPU block specs
+    # require (last two block dims must be tile-aligned or match the array).
+    kvm = jnp.pad(kvmask, ((0, 0), (0, skv_p - skv)))[:, None, :]
+
+    grid = (b, h, sq_p // bq, skv_p // bk)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            causal_offset=skv - sq,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, sq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, kvm)
+    return o, lse, (qt, kt, vt, kvm)
+
+
+# ---------------------------------------------------------------------------
+# Backward: dq over (q_blocks, kv_blocks); dk/dv over (kv_blocks, q_blocks).
+# Probability tiles are recomputed from the saved logsumexp.
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
+               dq_ref, dq_scr,
+               *, scale, causal, block_q, block_k, causal_offset):
+    qi, kv = pl.program_id(2), pl.program_id(3)
+    nkv = pl.num_programs(3)
+
+    @pl.when(kv == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_tile_contributes(qi, kv, causal, block_q, block_k, causal_offset))
+    def _accumulate():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, 0, :][:, None]
+        delta = dlt_ref[0, 0, 0, :][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        keep = _tile_keep(kvm_ref[0, 0, :], qi, kv, causal,
+                          block_q, block_k, causal_offset)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kv == nkv - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, block_q, block_k, causal_offset):
+    kv, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_tile_contributes(qi, kv, causal, block_q, block_k, causal_offset))
+    def _accumulate():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, 0, :][:, None]
+        delta = dlt_ref[0, 0, 0, :][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        keep = _tile_keep(kvm_ref[0, 0, :], qi, kv, causal,
+                          block_q, block_k, causal_offset)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kvmask, causal, scale, block_q, block_k, interpret):
+    o, _, _ = _fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret)
+    return o[:, :, : q.shape[1], :].transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret):
+    o, lse, _ = _fwd(
+        q, k, v, kvmask, causal, scale, block_q, block_k, interpret
+    )
+    out = o[:, :, : q.shape[1], :].transpose(0, 2, 1, 3)
+    return out, (q, k, v, kvmask, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, kvmask, o, lse = res
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    bq, bk = _block_sizes(sq, skv, block_q, block_k)
+    sq_p, skv_p = _round_up(sq, bq), _round_up(skv, bk)
+
+    # Same padded BHSD layout as the forward.
+    qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    kt = jnp.pad(k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    vt = jnp.pad(v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
+    kvm = jnp.pad(kvmask, ((0, 0), (0, skv_p - skv)))[:, None, :]
+    do = jnp.pad(
+        g.astype(qt.dtype).transpose(0, 2, 1, 3),
+        ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)),
+    )
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )[:, :, None, :]
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0),
+                           memory_space=pltpu.VMEM)
+    kvm_spec = pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
+                            memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            causal_offset=skv - sq,
+        ),
+        grid=(b, h, sq_p // bq, skv_p // bk),
+        in_specs=[q_spec, kv_spec, kv_spec, kvm_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d), qt.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, kvm, do, lse, delta)[0]
+
+    # kv-major grid: swap the roles of the last two grid axes in the specs.
+    q_spec_t = pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0),
+                            memory_space=pltpu.VMEM)
+    kv_spec_t = pl.BlockSpec((1, 1, bk, d), lambda b, h, j, i: (b, h, j, 0),
+                             memory_space=pltpu.VMEM)
+    kvm_spec_t = pl.BlockSpec((1, 1, bk), lambda b, h, j, i: (b, 0, j),
+                              memory_space=pltpu.VMEM)
+    row_spec_t = pl.BlockSpec((1, 1, 1, bq), lambda b, h, j, i: (b, h, 0, i),
+                              memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            causal_offset=skv - sq,
+        ),
+        grid=(b, h, skv_p // bk, sq_p // bq),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, kvm_spec_t, q_spec_t,
+                  row_spec_t, row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, skv_p, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h, skv_p, d), vt.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, kvm, do, lse, delta)
+
+    dq = dq[:, :, :sq, :].transpose(0, 2, 1, 3)
+    dk = dk[:, :, :skv, :].transpose(0, 2, 1, 3)
+    dv = dv[:, :, :skv, :].transpose(0, 2, 1, 3)
+    return dq, dk, dv, jnp.zeros_like(kvmask)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention on [B, S, H, D] inputs (same contract as
+    tpudl.ops.attention.dot_product_attention).
+
+    ``mask`` may be a [B, Skv] kv-validity mask or a broadcastable
+    [B, 1, 1, Skv] padding mask (tpudl.ops.attention.padding_mask output);
+    dense [B, H, Sq, Skv] masks are rejected — use the reference
+    implementation for those.
+    """
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+
+    if mask is None:
+        kvmask = jnp.ones((b, skv), jnp.float32)
+    else:
+        if mask.ndim == 4:
+            if mask.shape[1] != 1 or mask.shape[2] != 1:
+                raise NotImplementedError(
+                    "flash_attention supports padding masks [B, 1, 1, Skv] or "
+                    "[B, Skv] and in-kernel causal masking; got dense mask "
+                    f"shape {mask.shape} — use implementation='reference'"
+                )
+            mask = mask[:, 0, 0, :]
+        kvmask = jnp.broadcast_to(mask, (b, skv)).astype(jnp.float32)
+
+    return _flash(q, k, v, kvmask, causal, scale, block_q, block_k, interpret)
