@@ -1,0 +1,134 @@
+"""Train-state checkpoint/resume (SURVEY.md §5.4): the recovery story the
+reference lacks (it writes three serialization formats, reads none back —
+reference notebooks/cv/onnx_experiments.py:33-42,198,212-215)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tpudl.checkpoint import (
+    CheckpointManager,
+    restore_train_state,
+    save_train_state,
+)
+from tpudl.data.synthetic import synthetic_classification_batches
+from tpudl.models import ResNet18
+from tpudl.parallel.sharding import FSDP_RULES
+from tpudl.runtime.mesh import MeshSpec, make_mesh
+from tpudl.train import (
+    compile_step,
+    create_train_state,
+    make_classification_train_step,
+)
+
+
+def _fresh_state(seed=0):
+    model = ResNet18(num_classes=10, small_inputs=True)
+    return create_train_state(
+        jax.random.key(seed),
+        model,
+        jnp.zeros((1, 16, 16, 3)),
+        optax.adamw(1e-3),
+    )
+
+
+def _batches(n):
+    return list(
+        synthetic_classification_batches(
+            8, image_shape=(16, 16, 3), num_classes=10, num_batches=n
+        )
+    )
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = _fresh_state()
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, state)
+    restored = restore_train_state(path, _fresh_state(seed=1))
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(restored.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == int(state.step)
+    # Optimizer state (adamw mu/nu) round-trips too.
+    for a, b in zip(
+        jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    """train 5 -> save -> train 5 more == train 10 straight (exact, CPU)."""
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step_fn = make_classification_train_step()
+    rng = jax.random.key(42)
+    batches = _batches(10)
+
+    # Uninterrupted run.
+    state_a = _fresh_state()
+    step_a = compile_step(step_fn, mesh, state_a, None, donate_state=False)
+    losses_a = []
+    for b in batches:
+        state_a, m = step_a(state_a, b, rng)
+        losses_a.append(float(m["loss"]))
+
+    # Interrupted at step 5.
+    state_b = _fresh_state()
+    step_b = compile_step(step_fn, mesh, state_b, None, donate_state=False)
+    for b in batches[:5]:
+        state_b, _ = step_b(state_b, b, rng)
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, state_b)
+
+    # "New process": fresh init, restore, continue on batches[5:].
+    state_c = restore_train_state(path, _fresh_state(seed=9))
+    assert int(state_c.step) == 5
+    step_c = compile_step(step_fn, mesh, state_c, None, donate_state=False)
+    losses_c = []
+    for b in batches[5:]:
+        state_c, m = step_c(state_c, b, rng)
+        losses_c.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses_c, losses_a[5:], rtol=1e-6, atol=1e-7)
+
+
+def test_sharded_restore_onto_mesh(mesh8, tmp_path):
+    """Restore places leaves per FSDP rules on the 8-device mesh: the
+    resume-on-a-topology path for big models."""
+    state = _fresh_state()
+    path = str(tmp_path / "ckpt")
+    save_train_state(path, state)
+
+    restored = restore_train_state(
+        path, _fresh_state(seed=2), mesh=mesh8, rules=FSDP_RULES
+    )
+    # The largest conv kernel must actually land fsdp-sharded.
+    leaves = jax.tree_util.tree_leaves_with_path(restored.params)
+    sharded = [
+        (jax.tree_util.keystr(p), l) for p, l in leaves
+        if hasattr(l, "sharding") and not l.sharding.is_fully_replicated
+    ]
+    assert sharded, "no parameter came back sharded under FSDP rules"
+    for _, leaf in sharded:
+        assert "fsdp" in str(leaf.sharding.spec)
+
+
+def test_checkpoint_manager_retention_and_latest(tmp_path):
+    state = _fresh_state()
+    with CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2) as mgr:
+        for s in (1, 2, 3):
+            state = state.replace(step=jnp.asarray(s, jnp.int32))
+            assert mgr.save(s, state)
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 3
+        assert list(mgr.all_steps()) == [2, 3]
+        restored = mgr.restore(_fresh_state(seed=3))
+        assert int(restored.step) == 3
+
+
+def test_manager_restore_without_checkpoint_raises(tmp_path):
+    with CheckpointManager(str(tmp_path / "empty")) as mgr:
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(_fresh_state())

@@ -136,6 +136,59 @@ def test_prefetch_propagates_errors(mesh8):
         list(it)
 
 
+def test_shuffle_mixes_across_row_groups(tmp_path):
+    """A label-sorted Parquet layout (common for Delta exports) must still
+    yield mixed batches under shuffle — randomization has to span row
+    groups, not just permute within one."""
+    d = str(tmp_path / "sorted")
+    labels = np.repeat(np.arange(10), 100)  # 1000 rows, sorted by label
+    write_parquet(
+        d,
+        {"label": labels.astype(np.int64)},
+        rows_per_file=500,
+    )
+    conv = make_converter(d)
+    batch = next(
+        conv.make_batch_iterator(
+            100, shuffle=True, seed=0, shard_index=0, num_shards=1
+        )
+    )
+    # Unshuffled, a 100-row batch holds exactly 1 label; shuffled over the
+    # whole 1000-row buffer it should draw from most of the 10 classes.
+    assert len(set(batch["label"].tolist())) >= 6
+
+
+def test_all_shards_yield_identical_batch_counts(dataset_dir):
+    """Per-file min-shard-length truncation: every process must take the
+    same number of steps or stragglers hang peers inside collectives
+    (ADVICE.md round-1). 1000 rows over 3 shards is the uneven case."""
+    counts = []
+    conv = make_converter(dataset_dir)
+    for s in range(3):
+        n = sum(
+            1
+            for _ in conv.make_batch_iterator(
+                16, epochs=1, shard_index=s, num_shards=3
+            )
+        )
+        counts.append(n)
+    assert len(set(counts)) == 1, counts
+
+
+def test_steps_per_epoch_matches_actual_yield(dataset_dir):
+    """steps_per_epoch is what schedules are built against — it must equal
+    the true drop_last yield (VERDICT.md round-1 weak #9)."""
+    conv = make_converter(dataset_dir)
+    for num_shards, batch in ((1, 64), (3, 16), (4, 10), (7, 8)):
+        actual = sum(
+            1
+            for _ in conv.make_batch_iterator(
+                batch, epochs=1, shard_index=0, num_shards=num_shards
+            )
+        )
+        assert conv.steps_per_epoch(batch, num_shards=num_shards) == actual
+
+
 def test_bad_shard_index(dataset_dir):
     conv = make_converter(dataset_dir)
     with pytest.raises(ValueError, match="shard_index"):

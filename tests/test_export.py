@@ -86,3 +86,35 @@ def test_latency_benchmark_shape(args):
     assert result["iters"] == 3
     assert result["compute"]["mean_ms"] >= 0.0
     assert result["transfer"]["p95_ms"] >= 0.0
+
+
+def test_resnet_export_load_parity(tmp_path, rng_np):
+    """The reference's signature behavior as a pytest guard: the flagship
+    CV model family through export -> load -> numerical parity at the
+    reference tolerances (reference notebooks/cv/onnx_experiments.py:
+    33-42 export, :81 load, :142-144 allclose), on a tiny ResNet."""
+    from tpudl.models import ResNet
+    from tpudl.models.resnet import ResNetBlock
+
+    model = ResNet(
+        stage_sizes=(1, 1), block_cls=ResNetBlock, num_classes=10,
+        num_filters=8, dtype=jnp.float32, small_inputs=True,
+    )
+    x = rng_np.normal(size=(2, 16, 16, 3)).astype(np.float32)
+    variables = model.init(jax.random.key(0), jnp.asarray(x), train=False)
+
+    def forward(params, batch_stats, images):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats}, images, train=False
+        )
+
+    args = (variables["params"], variables["batch_stats"], jnp.asarray(x))
+    path = str(tmp_path / "resnet.stablehlo")
+    export_stablehlo(forward, args, path=path)
+    restored = load_exported(path)
+    np.testing.assert_allclose(
+        np.asarray(restored(*args)),
+        np.asarray(forward(*args)),
+        rtol=1e-5,
+        atol=1e-4,  # the reference's parity contract
+    )

@@ -1,0 +1,149 @@
+"""Train-state checkpoint / resume (Orbax), the recovery half of the
+failure story.
+
+The reference serializes models three ways but never training state and
+never reads anything back to resume (reference
+notebooks/cv/onnx_experiments.py:33-42,198,212-215 — ONNX export,
+whole-module pickle, TorchScript trace; SURVEY.md §5.4). Here the full
+TrainState — params, optimizer state, step counter, BatchNorm statistics —
+round-trips through step-indexed Orbax checkpoints, and restore is
+sharding-aware: leaves come back already placed according to the mesh +
+rule set of the run being resumed (possibly a different topology than the
+one that saved), so no full-state replication spike on big models.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh
+
+from tpudl.parallel.sharding import Rules, tree_shardings
+from tpudl.train.loop import TrainState
+
+
+def _state_payload(state: TrainState) -> dict:
+    """The serializable subset of a TrainState (apply_fn/tx are code, not
+    data — they come from the resuming program)."""
+    payload = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        # step may be a Python int on a fresh state; canonicalize for Orbax.
+        "step": jax.numpy.asarray(state.step, jax.numpy.int32),
+    }
+    if state.batch_stats is not None:
+        payload["batch_stats"] = state.batch_stats
+    return payload
+
+
+def _abstract_payload(
+    state: TrainState, mesh: Optional[Mesh], rules: Optional[Rules]
+) -> dict:
+    """ShapeDtypeStruct tree for restore; with a mesh, each leaf carries the
+    NamedSharding the rule set assigns, so Orbax materializes shards
+    directly onto devices."""
+    payload = _state_payload(state)
+    if mesh is None:
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jax.numpy.shape(x), x.dtype), payload
+        )
+    shardings = tree_shardings(mesh, payload, rules)
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(jax.numpy.shape(x), x.dtype, sharding=s),
+        payload,
+        shardings,
+    )
+
+
+def save_train_state(path: str, state: TrainState, overwrite: bool = True) -> None:
+    """One-shot full-train-state checkpoint at `path`."""
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), _state_payload(state), force=overwrite)
+
+
+def restore_train_state(
+    path: str,
+    state: TrainState,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[Rules] = None,
+) -> TrainState:
+    """Restore a checkpoint into `state`'s structure (a freshly-initialized
+    TrainState from the same model/optimizer code). With `mesh`/`rules`,
+    leaves arrive sharded for that topology."""
+    with ocp.StandardCheckpointer() as ckptr:
+        payload = ckptr.restore(
+            os.path.abspath(path), _abstract_payload(state, mesh, rules)
+        )
+    return state.replace(
+        params=payload["params"],
+        opt_state=payload["opt_state"],
+        step=payload["step"],
+        batch_stats=payload.get("batch_stats", state.batch_stats),
+    )
+
+
+class CheckpointManager:
+    """Step-indexed checkpoints with retention — the periodic-save side of
+    fail-fast-then-resume (SURVEY.md §5.3/§5.4).
+
+    save() is asynchronous (training continues while shards flush);
+    close()/context-manager exit drains pending writes.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def save(self, step: int, state: TrainState) -> bool:
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(_state_payload(state))
+        )
+
+    def restore(
+        self,
+        state: TrainState,
+        step: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        rules: Optional[Rules] = None,
+    ) -> TrainState:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found in {self._mgr.directory}"
+                )
+        payload = self._mgr.restore(
+            step,
+            args=ocp.args.StandardRestore(_abstract_payload(state, mesh, rules)),
+        )
+        return state.replace(
+            params=payload["params"],
+            opt_state=payload["opt_state"],
+            step=payload["step"],
+            batch_stats=payload.get("batch_stats", state.batch_stats),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

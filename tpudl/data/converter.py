@@ -126,6 +126,8 @@ class Converter:
 
     files: List[str]
     num_rows: int
+    #: Per-file row counts (same order as `files`); drives steps_per_epoch.
+    files_rows: Optional[List[int]] = None
 
     def __len__(self) -> int:
         return self.num_rows
@@ -140,13 +142,16 @@ class Converter:
         shard_index: Optional[int] = None,
         num_shards: Optional[int] = None,
         columns: Optional[Sequence[str]] = None,
+        shuffle_buffer: int = 8192,
     ) -> Iterator[Dict[str, np.ndarray]]:
         """Yield batches for this process's shard.
 
-        epochs=None iterates forever. Rows are sharded by index
-        (round-robin over row blocks) so shards are disjoint and their
-        union covers the dataset; defaults come from the JAX process
-        topology exactly like Petastorm's cur_shard/shard_count.
+        epochs=None iterates forever. Rows are sharded round-robin by
+        index, so shards are disjoint; every shard is truncated to the
+        per-file minimum shard length, guaranteeing identical step counts
+        on every process (at most num_shards-1 rows per file are dropped).
+        Defaults come from the JAX process topology exactly like
+        Petastorm's cur_shard/shard_count.
         """
         if shard_index is None or num_shards is None:
             import jax
@@ -160,47 +165,113 @@ class Converter:
         while epochs is None or epoch < epochs:
             rng = np.random.default_rng(seed + epoch) if shuffle else None
             yield from self._epoch_batches(
-                batch_size, rng, shard_index, num_shards, drop_last, columns
+                batch_size,
+                rng,
+                shard_index,
+                num_shards,
+                drop_last,
+                columns,
+                shuffle_buffer,
             )
             epoch += 1
 
-    def _epoch_batches(
-        self, batch_size, rng, shard_index, num_shards, drop_last, columns
-    ):
+    def _shard_chunks(self, rng, shard_index, num_shards, columns):
+        """Stream this shard's rows file-by-file, row group by row group
+        (never a whole file in memory — ImageNet-scale shards stay bounded
+        by the Parquet row-group size).
+
+        Round-robin row sharding within each file keeps shards disjoint;
+        every shard is truncated to the per-file minimum shard length
+        (n // num_shards), so all processes see identical batch counts —
+        a process with one extra row would otherwise hang its peers inside
+        the collectives of the final step.
+        """
         file_order = list(range(len(self.files)))
         if rng is not None:
             rng.shuffle(file_order)
-        carry: Optional[Dict[str, np.ndarray]] = None
+        cols = list(columns) if columns else None
         for fi in file_order:
-            table = pq.read_table(self.files[fi], columns=list(columns) if columns else None)
-            data = _decode_table(table)
-            n = len(table)
-            # Round-robin row sharding within the file keeps shards disjoint
-            # regardless of file count vs process count.
-            idx = np.arange(shard_index, n, num_shards)
+            pf = pq.ParquetFile(self.files[fi])
+            quota = pf.metadata.num_rows // num_shards  # equal across shards
+            taken = 0
+            offset = 0
+            for rg in range(pf.metadata.num_row_groups):
+                table = pf.read_row_group(rg, columns=cols)
+                data = _decode_table(table)
+                m = len(table)
+                local = np.arange(m)
+                sel = local[(offset + local) % num_shards == shard_index]
+                offset += m
+                if taken + len(sel) > quota:
+                    sel = sel[: quota - taken]
+                taken += len(sel)
+                if len(sel):
+                    yield {k: v[sel] for k, v in data.items()}
+
+    def _epoch_batches(
+        self,
+        batch_size,
+        rng,
+        shard_index,
+        num_shards,
+        drop_last,
+        columns,
+        shuffle_buffer,
+    ):
+        """Assemble batches from the chunk stream. With shuffle on, rows
+        pool into a `shuffle_buffer`-row buffer that is permuted before
+        batches are cut — randomization spans row groups and files (a
+        sorted/clustered Parquet layout would otherwise yield
+        near-homogeneous batches), with memory bounded by the buffer."""
+        pool: Optional[Dict[str, np.ndarray]] = None
+
+        def drain(pool, final):
+            n_rows = len(next(iter(pool.values())))
             if rng is not None:
-                rng.shuffle(idx)
-            shard = {k: v[idx] for k, v in data.items()}
-            if carry is not None:
-                shard = {
-                    k: np.concatenate([carry[k], shard[k]]) for k in shard
+                perm = rng.permutation(n_rows)
+                pool = {k: v[perm] for k, v in pool.items()}
+            full = (n_rows // batch_size) * batch_size
+            batches = [
+                {k: v[start : start + batch_size] for k, v in pool.items()}
+                for start in range(0, full, batch_size)
+            ]
+            rest = (
+                {k: v[full:] for k, v in pool.items()} if full < n_rows else None
+            )
+            if final and rest is not None and not drop_last:
+                batches.append(rest)
+                rest = None
+            return batches, rest
+
+        for chunk in self._shard_chunks(rng, shard_index, num_shards, columns):
+            if pool is None:
+                pool = chunk
+            else:
+                pool = {
+                    k: np.concatenate([pool[k], chunk[k]]) for k in pool
                 }
-            m = len(next(iter(shard.values()))) if shard else 0
-            full = (m // batch_size) * batch_size
-            for start in range(0, full, batch_size):
-                yield {k: v[start : start + batch_size] for k, v in shard.items()}
-            carry = {k: v[full:] for k, v in shard.items()} if full < m else None
-        if carry is not None and not drop_last:
-            m = len(next(iter(carry.values())))
-            if m:
-                yield carry
+            n_rows = len(next(iter(pool.values())))
+            if rng is not None and n_rows < shuffle_buffer:
+                continue  # keep pooling for shuffle quality
+            if n_rows >= batch_size:
+                batches, pool = drain(pool, final=False)
+                yield from batches
+        if pool is not None:
+            batches, _ = drain(pool, final=True)
+            yield from batches
 
     def steps_per_epoch(self, batch_size: int, num_shards: Optional[int] = None) -> int:
+        """Exact per-process batch count of one drop_last epoch: the sum of
+        per-file truncated shard lengths, floor-divided by batch size (the
+        carry crosses file boundaries, so no per-file flooring)."""
         if num_shards is None:
             import jax
 
             num_shards = jax.process_count()
-        return (self.num_rows // num_shards) // batch_size
+        rows = self.files_rows
+        if rows is None:
+            rows = [pq.ParquetFile(f).metadata.num_rows for f in self.files]
+        return sum(n // num_shards for n in rows) // batch_size
 
 
 def make_converter(source: str | Sequence[str]) -> Converter:
@@ -225,8 +296,10 @@ def make_converter(source: str | Sequence[str]) -> Converter:
         files = list(source)
     if not files:
         raise ValueError(f"no parquet files found in {source!r}")
-    num_rows = sum(pq.ParquetFile(f).metadata.num_rows for f in files)
-    return Converter(files=files, num_rows=num_rows)
+    files_rows = [pq.ParquetFile(f).metadata.num_rows for f in files]
+    return Converter(
+        files=files, num_rows=sum(files_rows), files_rows=files_rows
+    )
 
 
 # ---------------------------------------------------------------------------

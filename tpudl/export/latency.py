@@ -22,15 +22,17 @@ import numpy as np
 
 
 def _sync(out) -> float:
-    """Force completion of `out`'s computation via a scalar readback.
-
-    The slice executes on device, so only ONE element crosses to the host —
-    the timing window stays free of a full device-to-host copy.
+    """Force completion of `out` via scalar readbacks — one element per
+    leaf, so every transfer/computation in the tree is fenced while only
+    single elements cross to the host (never a full device-to-host copy).
     """
-    leaf = jax.tree.leaves(out)[0]
-    if isinstance(leaf, jax.Array):
-        return float(leaf.ravel()[0])
-    return float(np.asarray(leaf).ravel()[0])
+    total = 0.0
+    for leaf in jax.tree.leaves(out):
+        if isinstance(leaf, jax.Array):
+            total += float(leaf.ravel()[0])
+        else:
+            total += float(np.asarray(leaf).ravel()[0])
+    return total
 
 
 def latency_benchmark(
@@ -47,15 +49,17 @@ def latency_benchmark(
         device = jax.devices()[0]
     jitted = jax.jit(fn)
 
-    # --- transfer: host -> device, timed per iteration ---
+    # --- transfer: host -> device, timed per iteration; windows closed by
+    # scalar readback, not block_until_ready (module docstring doctrine —
+    # block_until_ready can return early on relay-attached devices) ---
     transfer_ms = []
     for _ in range(warmup):
         placed = jax.tree.map(lambda a: jax.device_put(a, device), tuple(host_args))
-        jax.block_until_ready(placed)
+        _sync(placed)
     for _ in range(iters):
         t0 = time.perf_counter()
         placed = jax.tree.map(lambda a: jax.device_put(a, device), tuple(host_args))
-        jax.block_until_ready(placed)
+        _sync(placed)
         transfer_ms.append((time.perf_counter() - t0) * 1e3)
 
     # --- compute: device-resident args, synced by scalar readback ---

@@ -9,6 +9,19 @@ from tpudl.models.bert import (  # noqa: F401
     BertModel,
     params_from_hf_bert,
 )
+from tpudl.models.llama import (  # noqa: F401
+    LLAMA3_8B,
+    LLAMA_TINY,
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaForSequenceClassification,
+)
+from tpudl.models.lora import (  # noqa: F401
+    LoRADense,
+    lora_optimizer,
+    merge_lora,
+    trainable_param_count,
+)
 from tpudl.models.resnet import (  # noqa: F401
     ResNet,
     ResNet18,

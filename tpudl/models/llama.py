@@ -1,0 +1,254 @@
+"""Llama-family decoder (RoPE + RMSNorm + SwiGLU + GQA), TPU-native.
+
+The reference's stretch workload is a Llama-3-8B LoRA fine-tune
+(BASELINE.json configs[4]; the reference tree ships no decoder at all —
+SURVEY.md §0). First-party implementation, same design rules as
+tpudl.models.bert: bf16 compute / f32 params, f32 norms and softmax,
+attention through the tpudl.ops.attend seam (reference / Pallas flash /
+ring over `sp` — causal masking never materializes [S, S]), activation
+sharding constraints on the (dp, fsdp) x sp x tp mesh, optional per-layer
+remat. LoRA drops in via cfg.lora_rank>0, swapping the attention
+projections to tpudl.models.lora.LoRADense (frozen-base training is the
+optimizer's job — see lora.lora_optimizer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpudl.models.lora import LoRADense
+from tpudl.ops.attention import attend
+from tpudl.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    intermediate_size: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    num_labels: int = 2
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "reference"
+    remat: bool = False
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+LLAMA_TINY = partial(
+    LlamaConfig,
+    vocab_size=512,
+    hidden_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    intermediate_size=256,
+    max_seq_len=256,
+    rope_theta=10_000.0,
+)
+LLAMA3_8B = LlamaConfig
+
+#: Size-name registry for tpudl.models.registry.build_llama.
+LLAMA_SIZES = {
+    "llama-tiny": LLAMA_TINY,
+    "llama3-8b": LLAMA3_8B,
+}
+
+
+def _proj(cfg: LlamaConfig, features: int, name: str):
+    """Attention/MLP projection: plain Dense, or LoRADense when adapters
+    are on (cfg.lora_rank > 0)."""
+    if cfg.lora_rank > 0:
+        return LoRADense(
+            features,
+            rank=cfg.lora_rank,
+            alpha=cfg.lora_alpha,
+            use_bias=False,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name=name,
+        )
+    return nn.Dense(
+        features,
+        use_bias=False,
+        dtype=cfg.dtype,
+        kernel_init=nn.initializers.normal(0.02),
+        name=name,
+    )
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale).astype(dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding on [B, S, H, D] (rotate-half convention)."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )  # [d/2]
+    angles = positions[:, :, None].astype(jnp.float32) * inv_freq  # [B,S,d/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,S,1,d/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x32_1 * cos - x32_2 * sin, x32_2 * cos + x32_1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        cfg = self.cfg
+        B, S, _ = hidden.shape
+        hd = cfg.head_dim
+        q = _proj(cfg, cfg.num_heads * hd, "q_proj")(hidden)
+        k = _proj(cfg, cfg.num_kv_heads * hd, "k_proj")(hidden)
+        v = _proj(cfg, cfg.num_kv_heads * hd, "v_proj")(hidden)
+        q = q.reshape(B, S, cfg.num_heads, hd)
+        k = k.reshape(B, S, cfg.num_kv_heads, hd)
+        v = v.reshape(B, S, cfg.num_kv_heads, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        if cfg.num_kv_heads != cfg.num_heads:  # GQA: expand kv heads
+            reps = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+        q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
+        k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
+        v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
+        ctx = attend(
+            q, k, v, causal=True, implementation=cfg.attention_impl
+        ).reshape(B, S, cfg.num_heads * hd)
+        return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, positions):
+        cfg = self.cfg
+        attn = LlamaAttention(cfg, name="attention")(
+            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions
+        )
+        hidden = hidden + attn
+        x = RMSNorm(cfg.rms_norm_eps, name="post_attention_norm")(hidden)
+        gate = _proj(cfg, cfg.intermediate_size, "gate_proj")(x)
+        up = _proj(cfg, cfg.intermediate_size, "up_proj")(x)
+        down = _proj(cfg, cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
+        hidden = hidden + down
+        return constrain(hidden, ("dp", "fsdp"), "sp", "tp")
+
+
+class LlamaModel(nn.Module):
+    """Decoder stack: embeddings + N blocks + final RMSNorm."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        cfg = self.cfg
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        # Positions skip padding so RoPE phases match left-padded batches.
+        positions = jnp.maximum(
+            jnp.cumsum(attention_mask, axis=-1) - 1, 0
+        ).astype(jnp.int32)
+        x = nn.Embed(
+            cfg.vocab_size,
+            cfg.hidden_size,
+            embedding_init=nn.initializers.normal(0.02),
+            name="embed_tokens",
+        )(input_ids).astype(cfg.dtype)
+        x = constrain(x, ("dp", "fsdp"), "sp", "tp")
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions)
+        return RMSNorm(cfg.rms_norm_eps, name="final_norm")(x)
+
+
+class LlamaForCausalLM(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None):
+        x = LlamaModel(self.cfg, name="model")(input_ids, attention_mask)
+        logits = nn.Dense(
+            self.cfg.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.02),
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+class LlamaForSequenceClassification(nn.Module):
+    """configs[4]-style fine-tune head: classify from the last non-padding
+    token's hidden state (causal LM pooling)."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, train: bool = False):
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        x = LlamaModel(self.cfg, name="model")(input_ids, attention_mask)
+        last = jnp.maximum(jnp.sum(attention_mask, axis=-1) - 1, 0)
+        pooled = jnp.take_along_axis(
+            x, last[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        logits = nn.Dense(
+            self.cfg.num_labels,
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.02),
+            name="classifier",
+        )(pooled)
+        return logits.astype(jnp.float32)
+
+
+def build_llama(name: str, num_classes: int, dtype=jnp.bfloat16, **kwargs):
+    """Registry entry: 'llama-tiny' / 'llama3-8b', with a '-lora' suffix
+    enabling rank-16 adapters (override via lora_rank=)."""
+    base = name.removesuffix("-lora")
+    lora = name.endswith("-lora")
+    if base not in LLAMA_SIZES:
+        raise ValueError(
+            f"unknown llama size {base!r}; available: {sorted(LLAMA_SIZES)}"
+        )
+    if lora:
+        kwargs.setdefault("lora_rank", 16)
+    cfg = LLAMA_SIZES[base](num_labels=num_classes, dtype=dtype, **kwargs)
+    return LlamaForSequenceClassification(cfg)

@@ -1,0 +1,163 @@
+"""LoRA: low-rank adapters with a frozen base (BASELINE.json configs[4]).
+
+The reference lineage's stretch goal is a Llama LoRA fine-tune under
+FSDP→GSPMD sharding (BASELINE.json configs[4]; nothing in the reference
+tree implements it — SURVEY.md §0). TPU-native design:
+
+- `LoRADense` keeps the full-rank kernel as an ordinary parameter and adds
+  `lora_a` [in, r] / `lora_b` [r, out] with `b` zero-initialized, so the
+  adapted layer starts exactly equal to the base layer.
+- Freezing is an optimizer concern, not a model concern:
+  `lora_optimizer(tx)` wraps any optax transformation with
+  `optax.multi_transform` so only `lora_a`/`lora_b` (and explicitly listed
+  heads) receive updates — base kernels keep zero updates and never get
+  optimizer state moments (the memory win that makes 8B fit).
+- Sharding composes: `LORA_RULES` prepends adapter specs to any rule list;
+  `lora_a` shards its input dim over fsdp (like the base kernel),
+  `lora_b` its output dim over tp (column-parallel, same as the base).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from tpudl.parallel.sharding import Rules
+
+
+class LoRADense(nn.Module):
+    """Dense layer with a low-rank adapter: y = x W + (alpha/r) (x A) B.
+
+    Drop-in for nn.Dense (same param name "kernel"/"bias" for the base, so
+    pretrained-weight import paths are unchanged; adapters are new leaves).
+    """
+
+    features: int
+    rank: int
+    alpha: float = 16.0
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        in_features = x.shape[-1]
+        kernel = self.param(
+            "kernel", self.kernel_init, (in_features, self.features)
+        )
+        y = jnp.dot(x, kernel.astype(self.dtype))
+        if self.rank > 0:
+            lora_a = self.param(
+                "lora_a",
+                nn.initializers.normal(1.0 / self.rank),
+                (in_features, self.rank),
+            )
+            lora_b = self.param(
+                "lora_b", nn.initializers.zeros, (self.rank, self.features)
+            )
+            scaling = self.alpha / self.rank
+            y = y + jnp.dot(
+                jnp.dot(x, lora_a.astype(self.dtype)), lora_b.astype(self.dtype)
+            ) * scaling
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,))
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+#: Adapter sharding, composable by prepending to FSDP/TP rule lists:
+#: A like the base kernel's row dim (fsdp), B column-parallel (tp).
+LORA_RULES: Rules = (
+    (r"lora_a$", P("fsdp", None)),
+    (r"lora_b$", P(None, "tp")),
+)
+
+
+def compose_rules(*rule_lists: Rules) -> Rules:
+    """First-match-wins concatenation (earlier lists take precedence)."""
+    out: list = []
+    for rules in rule_lists:
+        out.extend(rules)
+    return tuple(out)
+
+
+def is_lora_param(path: str) -> bool:
+    """Whether a '/'-joined parameter path is an adapter leaf."""
+    return path.endswith("lora_a") or path.endswith("lora_b")
+
+
+def _path_str(path) -> str:
+    from tpudl.parallel.sharding import _path_str as ps
+
+    return ps(path)
+
+
+def lora_param_labels(
+    params: Any, extra_trainable: Iterable[str] = ()
+) -> Any:
+    """'train'/'freeze' label tree for optax.multi_transform. Paths whose
+    '/'-joined form contains any `extra_trainable` substring (e.g. a task
+    head: "classifier") also train."""
+    extra = tuple(extra_trainable)
+
+    def label(path, _):
+        p = _path_str(path)
+        if is_lora_param(p) or any(e in p for e in extra):
+            return "train"
+        return "freeze"
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def lora_optimizer(
+    tx: optax.GradientTransformation,
+    params: Any,
+    extra_trainable: Iterable[str] = (),
+) -> optax.GradientTransformation:
+    """Wrap `tx` so only adapter (+ `extra_trainable`) leaves update; frozen
+    leaves get set_to_zero, which also allocates no moments for them."""
+    labels = lora_param_labels(params, extra_trainable)
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, labels
+    )
+
+
+def trainable_param_count(
+    params: Any, extra_trainable: Iterable[str] = ()
+) -> Tuple[int, int]:
+    """(trainable, total) parameter counts under the LoRA split."""
+    labels = lora_param_labels(params, extra_trainable)
+    trainable = total = 0
+    for leaf, lab in zip(jax.tree.leaves(params), jax.tree.leaves(labels)):
+        total += leaf.size
+        if lab == "train":
+            trainable += leaf.size
+    return trainable, total
+
+
+def merge_lora(params: Any, alpha_by_rank: Optional[float] = None) -> Any:
+    """Fold adapters into base kernels (deploy-time: zero inference cost).
+
+    Returns a new tree where each module containing (kernel, lora_a,
+    lora_b) has kernel += (alpha/r) A B and the adapter leaves removed.
+    """
+
+    def merge(node):
+        if not isinstance(node, dict):
+            return node
+        out = {k: merge(v) for k, v in node.items()}
+        if "kernel" in out and "lora_a" in out and "lora_b" in out:
+            a, b = out.pop("lora_a"), out.pop("lora_b")
+            rank = a.shape[-1]
+            scaling = (
+                alpha_by_rank if alpha_by_rank is not None else 16.0 / rank
+            )
+            out["kernel"] = out["kernel"] + (a @ b) * scaling
+        return out
+
+    return merge(params)

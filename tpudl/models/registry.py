@@ -37,12 +37,7 @@ def build_model(name: str, num_classes: int, **kwargs: Any):
         cfg = _BERT_SIZES[name](num_labels=num_classes, dtype=dtype, **kwargs)
         return BertForSequenceClassification(cfg)
     if name.startswith("llama"):
-        try:
-            from tpudl.models.llama import build_llama
-        except ModuleNotFoundError as e:
-            raise NotImplementedError(
-                f"model {name!r}: the Llama family (BASELINE.json configs[4]) "
-                "is not in this build yet"
-            ) from e
+        from tpudl.models.llama import build_llama
+
         return build_llama(name, num_classes=num_classes, dtype=dtype, **kwargs)
     raise ValueError(f"unknown model name: {name!r}")

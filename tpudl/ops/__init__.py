@@ -1,1 +1,13 @@
-"""TPU kernels and fused ops (Pallas flash attention, ring attention)."""
+"""TPU attention ops behind one dispatch seam (tpudl.ops.attend):
+
+- attention.py        — reference einsum attention (+ masks, dropout);
+- flash_attention.py  — Pallas fused online-softmax kernel, fwd + bwd;
+- ring_attention.py   — sequence-parallel ring attention over `sp`.
+"""
+
+from tpudl.ops.attention import (  # noqa: F401
+    attend,
+    causal_mask,
+    dot_product_attention,
+    padding_mask,
+)

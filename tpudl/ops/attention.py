@@ -89,6 +89,11 @@ def attend(
     implementation; flash/ring reject a nonzero rate rather than silently
     dropping it (fine-tune with attention_dropout=0 on those paths).
     """
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError(
+            "dropout_rate > 0 requires a dropout_rng (dropout would "
+            "otherwise be silently skipped)"
+        )
     if implementation == "reference":
         if causal and mask is None:
             mask = causal_mask(q.shape[1], k.shape[1])

@@ -284,25 +284,22 @@ def _flash(q, k, v, kvmask, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret):
-    o, lse, _ = _fwd(
+    o, lse, (qt, kt, vt, kvm) = _fwd(
         q, k, v, kvmask, causal, scale, block_q, block_k, interpret
     )
     out = o[:, :, : q.shape[1], :].transpose(0, 2, 1, 3)
-    return out, (q, k, v, kvmask, o, lse)
+    # Padded tensors are the residuals (no re-pad in bwd); the unpadded
+    # kvmask rides along so bwd can recover the original Skv statically.
+    return out, (qt, kt, vt, kvm, kvmask, o, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, kvmask, o, lse = res
-    b, sq, h, d = q.shape
-    skv = k.shape[1]
+    qt, kt, vt, kvm, kvmask, o, lse = res
+    b, h, sq_p, d = qt.shape
+    skv_p = kt.shape[2]
+    sq, skv = g.shape[1], kvmask.shape[1]
     bq, bk = _block_sizes(sq, skv, block_q, block_k)
-    sq_p, skv_p = _round_up(sq, bq), _round_up(skv, bk)
 
-    # Same padded BHSD layout as the forward.
-    qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
-    kt = jnp.pad(k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
-    vt = jnp.pad(v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, skv_p - skv), (0, 0)))
-    kvm = jnp.pad(kvmask, ((0, 0), (0, skv_p - skv)))[:, None, :]
     do = jnp.pad(
         g.astype(qt.dtype).transpose(0, 2, 1, 3),
         ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)),

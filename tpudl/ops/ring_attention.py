@@ -1,0 +1,149 @@
+"""Ring attention: sequence-parallel attention over the `sp` mesh axis.
+
+Long-context support the reference lineage never had (its NLP family is an
+empty placeholder — reference notebooks/nlp/README.md; SURVEY.md §5.7
+records sequence parallelism as the declared TPU-idiomatic path). Design:
+activations arrive sharded [B, S/n, H, D] along `sp`; each device computes
+blockwise attention against the K/V shard it currently holds while
+`ppermute` rotates K/V (and the kv-validity mask) one hop around the ring.
+After n steps every query shard has seen every K/V shard, the partial
+softmax statistics having been merged online — the full [S, S] logits
+matrix never exists, per-device attention memory is O(S^2 / n^2), and the
+K/V transfers ride neighbor-to-neighbor ICI hops that overlap with the
+per-block compute.
+
+The loop is a `lax.scan` (reverse-differentiable, unlike while/fori), so
+the same code trains: gradients flow through `ppermute`'s transpose
+(another ppermute in the reverse direction, also riding ICI).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudl.ops.attention import MASK_VALUE
+from tpudl.runtime.mesh import AXIS_SEQ, BATCH_AXES, AXIS_TENSOR
+
+
+def _ring_local(q, k, v, kvm, *, axis_name, scale, causal):
+    """Per-device ring loop. q, k, v: [b, s_local, h, d]; kvm: [b, s_local].
+
+    Device i starts holding kv block i; after t rotations it holds block
+    (i - t) mod n. The online-softmax merge is the same recurrence as the
+    flash kernel's (tpudl.ops.flash_attention), at shard granularity.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_l, h, d = q.shape
+
+    q32 = q.astype(jnp.float32)
+    m0 = jnp.full((b, h, s_l, 1), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((b, h, s_l, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_l, d), jnp.float32)
+    q_ids = idx * s_l + jax.lax.broadcasted_iota(jnp.int32, (s_l, 1), 0)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, t):
+        m, l, acc, k, v, kvm = carry
+        src = (idx - t) % n  # global block index of the kv shard we hold
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k.astype(jnp.float32)) * scale
+        keep = (kvm > 0)[:, None, None, :]
+        if causal:
+            kv_ids = src * s_l + jax.lax.broadcasted_iota(
+                jnp.int32, (1, s_l), 1
+            )
+            keep = jnp.logical_and(keep, (kv_ids <= q_ids)[None, None, :, :])
+        s = jnp.where(keep, s, MASK_VALUE)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v.dtype), v
+        ).astype(jnp.float32)
+        k, v, kvm = (
+            jax.lax.ppermute(x, axis_name, perm) for x in (k, v, kvm)
+        )
+        return (m_new, l, acc, k, v, kvm), None
+
+    (m, l, acc, _, _, _), _ = jax.lax.scan(
+        body, (m0, l0, acc0, k, v, kvm), jnp.arange(n)
+    )
+    l_safe = jnp.where(l > 0.0, l, 1.0)
+    o = (acc / l_safe).transpose(0, 2, 1, 3)  # [b, s_l, h, d]
+    return o.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = AXIS_SEQ,
+) -> jax.Array:
+    """Sequence-parallel attention on [B, S, H, D] (the
+    tpudl.ops.attention contract; Sq == Skv required — queries and keys
+    shard along the same sequence axis).
+
+    ``mask`` may be a [B, S] kv-validity mask or a [B, 1, 1, S] padding
+    mask; dense masks are rejected like tpudl.ops.flash_attention.
+    ``mesh`` defaults to the active tpudl mesh
+    (tpudl.parallel.sharding.active_mesh); batch shards over (dp, fsdp),
+    sequence over `sp`, heads over `tp`.
+    """
+    from tpudl.ops.attention import causal_mask, dot_product_attention
+    from tpudl.parallel.sharding import current_mesh
+
+    if mesh is None:
+        mesh = current_mesh()
+    if mesh is None:
+        # No mesh (single-device init/eval): ring degenerates to reference
+        # attention — numerically identical, so models with
+        # attention_impl="ring" init and evaluate unmeshed.
+        if mask is None and causal:
+            mask = causal_mask(q.shape[1], k.shape[1])
+        return dot_product_attention(q, k, v, mask, scale=scale)
+    b, s, h, d = q.shape
+    if k.shape[1] != s:
+        raise ValueError(
+            f"ring attention shards q and kv along one sequence axis; "
+            f"got Sq={s}, Skv={k.shape[1]}"
+        )
+    n_sp = mesh.shape[axis_name]
+    if s % n_sp != 0:
+        raise ValueError(f"seq len {s} not divisible by {axis_name}={n_sp}")
+    if scale is None:
+        scale = d ** -0.5
+
+    if mask is None:
+        kvm = jnp.ones((b, s), jnp.int32)
+    else:
+        if mask.ndim == 4:
+            if mask.shape[1] != 1 or mask.shape[2] != 1:
+                raise NotImplementedError(
+                    "ring_attention supports [B, S] / [B, 1, 1, S] padding "
+                    f"masks and causal=True; got dense mask {mask.shape}"
+                )
+            mask = mask[:, 0, 0, :]
+        kvm = jnp.broadcast_to(mask, (b, s)).astype(jnp.int32)
+
+    batch = tuple(a for a in BATCH_AXES if mesh.shape[a] > 1) or None
+    heads = AXIS_TENSOR if h % max(mesh.shape[AXIS_TENSOR], 1) == 0 else None
+    qkv_spec = P(batch, axis_name, heads, None)
+    fn = jax.shard_map(
+        partial(_ring_local, axis_name=axis_name, scale=scale, causal=causal),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch, axis_name)),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kvm)

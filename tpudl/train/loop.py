@@ -166,13 +166,14 @@ def compile_step(
         jitted = jax.jit(
             step_fn,
             in_shardings=(state_sh, batch_sh, repl),
-            out_shardings=(state_sh, None),
+            out_shardings=(state_sh, repl),
             donate_argnums=(0,) if donate_state else (),
         )
     else:
         jitted = jax.jit(
             step_fn,
             in_shardings=(state_sh, batch_sh),
+            out_shardings=repl,
             donate_argnums=(0,) if donate_state else (),
         )
 
@@ -194,23 +195,49 @@ def fit(
     num_steps: Optional[int] = None,
     log_every: int = 0,
     logger: Optional[Callable[[int, dict], None]] = None,
+    profile_dir: Optional[str] = None,
+    profile_window: tuple = (2, 8),
 ):
     """Drive the compiled step over a batch iterator; returns final state and
-    the last metrics (host-synced once at the end, not per step)."""
+    the last metrics (host-synced once at the end, not per step).
+
+    Profiling (SURVEY.md §5.1): with `profile_dir` set — or the
+    TPUDL_PROFILE_DIR environment variable — steps
+    [profile_window[0], profile_window[1]) are captured with
+    jax.profiler.trace into a TensorBoard-viewable XLA trace (op-level,
+    including ICI collective time), skipping the compile step.
+    """
+    import os
+
+    profile_dir = profile_dir or os.environ.get("TPUDL_PROFILE_DIR")
+    prof_start, prof_stop = profile_window
+    profiling = False
+
     metrics = None
     start = time.perf_counter()
     n = 0
-    for i, batch in enumerate(batches):
-        if num_steps is not None and i >= num_steps:
-            break
-        state, metrics = compiled_step(state, batch, rng)
-        n += 1
-        if log_every and (i + 1) % log_every == 0:
-            host_metrics = {k: float(v) for k, v in metrics.items()}
-            if logger:
-                logger(i + 1, host_metrics)
-            else:
-                print(f"step {i + 1}: {host_metrics}")
+    try:
+        for i, batch in enumerate(batches):
+            if num_steps is not None and i >= num_steps:
+                break
+            if profile_dir and i == prof_start:
+                jax.profiler.start_trace(profile_dir)
+                profiling = True
+            state, metrics = compiled_step(state, batch, rng)
+            if profiling and i + 1 == prof_stop:
+                jax.block_until_ready(metrics)
+                jax.profiler.stop_trace()
+                profiling = False
+            n += 1
+            if log_every and (i + 1) % log_every == 0:
+                host_metrics = {k: float(v) for k, v in metrics.items()}
+                if logger:
+                    logger(i + 1, host_metrics)
+                else:
+                    print(f"step {i + 1}: {host_metrics}")
+    finally:
+        if profiling:
+            jax.profiler.stop_trace()
     if metrics is not None:
         metrics = {k: float(v) for k, v in metrics.items()}
     elapsed = time.perf_counter() - start
