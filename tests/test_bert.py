@@ -190,6 +190,28 @@ def test_loss_decreases_token_task():
     assert last < first * 0.7, f"loss did not decrease: {first} -> {last}"
 
 
+def test_bert_flash_attention_impl_matches_reference(rng_np):
+    """The attend() seam end-to-end: BERT with attention_impl='flash'
+    (Pallas kernel, interpreter mode on CPU) must reproduce the reference
+    einsum model's logits on identical params."""
+    import dataclasses
+
+    cfg_ref = BertConfig(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=2,
+        intermediate_size=128, max_position_embeddings=64,
+        hidden_dropout=0.0, attention_dropout=0.0, dtype=jnp.float32,
+    )
+    cfg_flash = dataclasses.replace(cfg_ref, attention_impl="flash")
+    ids, mask = _batch(rng_np, batch=2, seq=24, vocab=256)
+    variables = BertForSequenceClassification(cfg_ref).init(
+        jax.random.key(0), ids, mask
+    )
+    ref = BertForSequenceClassification(cfg_ref).apply(variables, ids, mask)
+    out = BertForSequenceClassification(cfg_flash).apply(variables, ids, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_attention_dropout_active_in_train_mode(rng_np):
     """Dropout on attention probabilities must change train-mode outputs
     (ADVICE.md round-1: the config field was silently unused)."""

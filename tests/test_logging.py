@@ -1,0 +1,82 @@
+"""Structured metrics logging (SURVEY.md §5.5 — the reference only ever
+print()s; reference notebooks/cv/onnx_experiments.py:100,104,140)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpudl.train import MetricLogger
+
+
+def test_jsonl_sink(tmp_path):
+    d = str(tmp_path / "run")
+    with MetricLogger(d, tensorboard=False) as ml:
+        ml.log(1, {"loss": 0.5, "accuracy": 0.9})
+        ml.log(2, {"loss": jnp.asarray(0.25), "accuracy": 0.95})
+    lines = [
+        json.loads(line)
+        for line in open(os.path.join(d, "metrics.jsonl"))
+    ]
+    assert lines[0] == {"step": 1, "loss": 0.5, "accuracy": 0.9}
+    assert lines[1]["loss"] == 0.25
+
+
+def test_tensorboard_sink(tmp_path):
+    d = str(tmp_path / "tb")
+    with MetricLogger(d, tensorboard=True) as ml:
+        ml.log(1, {"loss": 1.0})
+    # a tfevents file appears when the writer is available; JSONL always.
+    files = os.listdir(d)
+    assert "metrics.jsonl" in files
+    assert any("tfevents" in f for f in files)
+
+
+def test_stdlog_only_no_dir(caplog):
+    import logging
+
+    ml = MetricLogger(log_dir=None)
+    with caplog.at_level(logging.INFO, logger="tpudl.metrics"):
+        ml.log(3, {"loss": 0.125})
+    assert "step=3" in caplog.text and "loss=0.125" in caplog.text
+
+
+def test_as_fit_logger_callback(tmp_path):
+    """MetricLogger plugs straight into fit(logger=...)."""
+    from tpudl.data.synthetic import synthetic_classification_batches
+    from tpudl.models import ResNet18
+    from tpudl.runtime import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        fit,
+        make_classification_train_step,
+    )
+
+    model = ResNet18(num_classes=10, small_inputs=True)
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, 16, 16, 3)),
+        optax.sgd(0.1),
+    )
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(make_classification_train_step(), mesh, state, None)
+    d = str(tmp_path / "fitlog")
+    with MetricLogger(d, tensorboard=False) as ml:
+        fit(
+            step,
+            state,
+            synthetic_classification_batches(
+                8, image_shape=(16, 16, 3), num_batches=4
+            ),
+            jax.random.key(1),
+            log_every=2,
+            logger=ml,
+        )
+    lines = [json.loads(x) for x in open(os.path.join(d, "metrics.jsonl"))]
+    assert [x["step"] for x in lines] == [2, 4]
+    assert all(np.isfinite(x["loss"]) for x in lines)

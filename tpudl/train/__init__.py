@@ -1,5 +1,6 @@
-"""L3 training: Optax loops, pjit sharding, metrics."""
+"""L3 training: Optax loops, pjit sharding, metrics, structured logging."""
 
+from tpudl.train.logging import MetricLogger  # noqa: F401
 from tpudl.train.loop import (  # noqa: F401
     TrainState,
     compile_step,
