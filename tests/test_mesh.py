@@ -15,9 +15,10 @@ def test_fake_device_count():
 
 
 def test_meshspec_resolve_wildcard():
-    assert MeshSpec(dp=-1).resolve(8) == (8, 1, 1, 1)
-    assert MeshSpec(dp=-1, fsdp=2).resolve(8) == (4, 2, 1, 1)
-    assert MeshSpec(dp=2, fsdp=2, tp=2).resolve(8) == (2, 2, 1, 2)
+    assert MeshSpec(dp=-1).resolve(8) == (8, 1, 1, 1, 1, 1)
+    assert MeshSpec(dp=-1, fsdp=2).resolve(8) == (4, 2, 1, 1, 1, 1)
+    assert MeshSpec(dp=2, fsdp=2, tp=2).resolve(8) == (2, 2, 1, 2, 1, 1)
+    assert MeshSpec(dp=-1, pp=2, ep=2).resolve(8) == (2, 1, 1, 1, 2, 2)
 
 
 def test_meshspec_errors():

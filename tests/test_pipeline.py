@@ -1,0 +1,244 @@
+"""Pipeline parallelism (tpudl.parallel.pipeline) on the fake 8-CPU mesh.
+
+Strategy (SURVEY.md §4.2): the GPipe schedule must be numerically
+invisible — outputs and gradients match folding the stages sequentially on
+one device, for every mesh composition (pp alone, pp x dp).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudl.parallel.pipeline import (
+    num_ticks,
+    pipeline,
+    stack_pytrees,
+)
+from tpudl.parallel.sharding import active_mesh
+from tpudl.runtime.mesh import MeshSpec, make_mesh
+
+DIM = 8
+
+
+def _stage_fn(params, x):
+    """One homogeneous stage: tanh(x @ w + b) + x."""
+    return jnp.tanh(x @ params["w"] + params["b"]) + x
+
+
+def _make_stage_params(key, n_stages):
+    keys = jax.random.split(key, n_stages)
+    return [
+        {
+            "w": jax.random.normal(k, (DIM, DIM)) * 0.3,
+            "b": jnp.zeros((DIM,)),
+        }
+        for k in keys
+    ]
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+def test_num_ticks():
+    assert num_ticks(4, 8) == 11
+    assert num_ticks(1, 8) == 8
+
+
+def test_pipeline_matches_sequential_pp4():
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, sp=1, tp=1, pp=4, ep=2))
+    stages = _make_stage_params(jax.random.key(0), 4)
+    stacked = stack_pytrees(stages)
+    x = jax.random.normal(jax.random.key(1), (16, DIM))
+
+    expected = _sequential(stages, x)
+    got = pipeline(
+        _stage_fn, stacked, x, num_microbatches=8, mesh=mesh
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-6)
+
+
+def test_pipeline_jit_with_sharded_params():
+    """Under jit with the stacked params actually device_put pp-sharded,
+    the schedule compiles and matches — the multi-chip deployment shape."""
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, sp=1, tp=1, pp=8, ep=1))
+    stages = _make_stage_params(jax.random.key(2), 8)
+    stacked = stack_pytrees(stages)
+    stacked = jax.device_put(
+        stacked,
+        jax.tree.map(
+            lambda p: NamedSharding(mesh, P(*(["pp"] + [None] * (p.ndim - 1)))),
+            stacked,
+        ),
+    )
+    x = jax.random.normal(jax.random.key(3), (32, DIM))
+
+    fn = jax.jit(
+        lambda pr, xx: pipeline(
+            _stage_fn, pr, xx, num_microbatches=16, mesh=mesh
+        )
+    )
+    got = fn(stacked, x)
+    expected = _sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-6)
+
+
+def test_pipeline_grad_matches_sequential():
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, sp=1, tp=1, pp=4, ep=2))
+    stages = _make_stage_params(jax.random.key(4), 4)
+    stacked = stack_pytrees(stages)
+    x = jax.random.normal(jax.random.key(5), (8, DIM))
+
+    def loss_pipe(p):
+        return jnp.sum(
+            pipeline(_stage_fn, p, x, num_microbatches=4, mesh=mesh) ** 2
+        )
+
+    def loss_seq(p):
+        y = x
+        for i in range(4):
+            y = _stage_fn(jax.tree.map(lambda a: a[i], p), y)
+        return jnp.sum(y**2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g_pipe,
+        g_seq,
+    )
+
+
+def test_pipeline_composes_with_dp():
+    """pp=4 x dp=2: microbatch split happens per data shard."""
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=1, sp=1, tp=1, pp=4, ep=1))
+    assert mesh.shape["pp"] == 4 and mesh.shape["dp"] == 2
+    stages = _make_stage_params(jax.random.key(6), 4)
+    stacked = stack_pytrees(stages)
+    x = jax.random.normal(jax.random.key(7), (16, DIM))
+
+    got = pipeline(
+        _stage_fn,
+        stacked,
+        x,
+        num_microbatches=4,
+        mesh=mesh,
+        batch_spec=P("dp"),
+    )
+    expected = _sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-6)
+
+
+def test_pipeline_degenerates_without_mesh():
+    stages = _make_stage_params(jax.random.key(8), 3)
+    stacked = stack_pytrees(stages)
+    x = jax.random.normal(jax.random.key(9), (4, DIM))
+    got = pipeline(_stage_fn, stacked, x, num_microbatches=2, mesh=None)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_sequential(stages, x)), atol=1e-6
+    )
+
+
+def test_pipeline_uses_active_mesh():
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, sp=1, tp=1, pp=4, ep=2))
+    stages = _make_stage_params(jax.random.key(10), 4)
+    stacked = stack_pytrees(stages)
+    x = jax.random.normal(jax.random.key(11), (8, DIM))
+    with active_mesh(mesh):
+        got = pipeline(_stage_fn, stacked, x, num_microbatches=4)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_sequential(stages, x)), atol=1e-6
+    )
+
+
+def test_pipeline_bert_layers():
+    """Pipeline real BertLayer stages (mask rides the carry pytree) and
+    match the sequential encoder stack."""
+    from tpudl.models.bert import BERT_TINY, BertLayer
+    from tpudl.ops.attention import padding_mask
+    from tpudl.parallel.pipeline import stack_layer_params
+
+    cfg = BERT_TINY(
+        num_layers=4,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        dtype=jnp.float32,  # isolate the schedule from bf16 rounding
+    )
+    layer = BertLayer(cfg)
+    B, S = 8, 16
+    hidden = jax.random.normal(
+        jax.random.key(20), (B, S, cfg.hidden_size)
+    ).astype(cfg.dtype)
+    mask = padding_mask(jnp.ones((B, S), jnp.int32))
+
+    layer_params = [
+        layer.init(jax.random.key(30 + i), hidden, mask, False)["params"]
+        for i in range(4)
+    ]
+    stacked = stack_pytrees(layer_params)
+
+    def stage_fn(p, carry):
+        h, msk = carry
+        return layer.apply({"params": p}, h, msk, False), msk
+
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=1, sp=1, tp=1, pp=4, ep=1))
+    got, _ = pipeline(
+        stage_fn,
+        stacked,
+        (hidden, mask),
+        num_microbatches=4,
+        mesh=mesh,
+        batch_spec=P("dp"),
+    )
+
+    expected = hidden
+    for p in layer_params:
+        expected = layer.apply({"params": p}, expected, mask, False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), atol=1e-5
+    )
+
+
+def test_stack_layer_params_path():
+    from tpudl.parallel.pipeline import stack_layer_params
+
+    params = {
+        "encoder": {
+            "layer_0": {"w": jnp.ones((2,))},
+            "layer_1": {"w": jnp.zeros((2,))},
+        }
+    }
+    stacked = stack_layer_params(params, "encoder/layer_{}", 2)
+    assert stacked["w"].shape == (2, 2)
+    np.testing.assert_allclose(np.asarray(stacked["w"][0]), 1.0)
+
+
+def test_pipeline_validates_shapes():
+    mesh = make_mesh(MeshSpec(dp=1, fsdp=1, sp=1, tp=1, pp=4, ep=2))
+    stages = _make_stage_params(jax.random.key(12), 3)  # wrong stage count
+    stacked = stack_pytrees(stages)
+    x = jnp.zeros((8, DIM))
+    with pytest.raises(ValueError, match="leading dim"):
+        pipeline(_stage_fn, stacked, x, num_microbatches=4, mesh=mesh)
+    stages4 = _make_stage_params(jax.random.key(13), 4)
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline(
+            _stage_fn, stack_pytrees(stages4), x, num_microbatches=3, mesh=mesh
+        )
+    # Microbatch must divide the batch_spec mesh extent (pp x dp mesh).
+    mesh2 = make_mesh(MeshSpec(dp=2, fsdp=1, sp=1, tp=1, pp=4, ep=1))
+    with pytest.raises(ValueError, match="microbatch size"):
+        pipeline(
+            _stage_fn,
+            stack_pytrees(stages4),
+            x,
+            num_microbatches=8,  # mb=1, not divisible by dp=2
+            mesh=mesh2,
+            batch_spec=P("dp"),
+        )

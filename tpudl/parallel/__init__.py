@@ -1,5 +1,12 @@
-"""Parallelism: sharding rules and activation constraints over the mesh."""
+"""Parallelism: sharding rules, activation constraints, pipeline schedule."""
 
+from tpudl.parallel.pipeline import (  # noqa: F401
+    PIPELINE_RULES,
+    pipeline,
+    stack_layer_params,
+    stack_pytrees,
+    stage_param_spec,
+)
 from tpudl.parallel.sharding import (  # noqa: F401
     Rules,
     active_mesh,

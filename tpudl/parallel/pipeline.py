@@ -1,0 +1,245 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe microbatching).
+
+The reference lineage has no pipeline story (SURVEY.md §2.3 marks PP absent
+from the reference tree; the only parallelism the north-star names is the
+Horovod-style data-parallel launch path). This module makes layer-pipelined
+training first-class the TPU way: no process-rank send/recv loops — one
+SPMD program under ``shard_map`` where each ``pp`` mesh slot runs its stage
+and activations hop exactly one ICI neighbor per tick via ``ppermute``.
+
+Schedule: classic GPipe. The batch splits into M microbatches; a pipeline
+of S stages runs ``M + S - 1`` ticks (a ``lax.scan``, so the whole schedule
+is one compiled XLA loop and is reverse-differentiable — backward replays
+the ring with the transposed permutation). Bubble fraction is
+``(S-1)/(M+S-1)``: pick ``num_microbatches >> pp`` to amortize.
+
+Stages must be shape-homogeneous (stage out like stage in) — the usual
+transformer-block case. Stage weights live stacked on a leading
+``[num_stages, ...]`` dim sharded over ``pp`` (`stack_pytrees` /
+`PIPELINE_RULES`), so each device holds only its own stage's weights:
+parameter and optimizer memory scale 1/pp. Activation buffers do NOT: the
+microbatched input and the output buffer are replicated over ``pp`` (only
+stage 0 / the last stage read or write them — the simple-schedule cost;
+each is one local batch of activations, small next to the weights).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudl.runtime.mesh import AXIS_PIPE
+
+def stage_param_spec(ndim: int, axis_name: str = AXIS_PIPE) -> P:
+    """PartitionSpec for one stacked stage param: leading (stage) dim over
+    the pipeline axis, everything else replicated."""
+    return P(*([axis_name] + [None] * (ndim - 1)))
+
+
+#: Sharding rules for stacked stage params: leading (stage) dim over pp.
+PIPELINE_RULES = ((r".*", lambda shape: stage_param_spec(len(shape))),)
+
+
+def stack_pytrees(trees: Sequence[Any]) -> Any:
+    """Stack per-stage param trees into one tree with a leading stage dim
+    (the layout `pipeline` consumes; shard it P('pp', ...) on dim 0)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def stack_layer_params(
+    params: Any, layer_fmt: str, num_layers: int
+) -> Any:
+    """Stack the per-layer subtrees ``params[...][layer_fmt.format(i)]``
+    into one tree with a leading stage dim.
+
+    ``layer_fmt`` is a '/'-separated path with one ``{}`` placeholder,
+    e.g. ``"encoder/layer_{}"`` for tpudl.models.bert parameter trees.
+    """
+
+    def lookup(i: int):
+        node = params
+        for part in layer_fmt.format(i).split("/"):
+            node = node[part]
+        return node
+
+    return stack_pytrees([lookup(i) for i in range(num_layers)])
+
+
+def num_ticks(num_stages: int, num_microbatches: int) -> int:
+    return num_microbatches + num_stages - 1
+
+
+def _pipeline_local(
+    params: Any,
+    x: jax.Array,
+    *,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    axis_name: str,
+    num_microbatches: int,
+):
+    """Per-device GPipe schedule. Runs inside shard_map over `axis_name`.
+
+    params: this stage's weights (a [1, ...]-blocked shard of the stacked
+    tree). x: the full [M, mb, ...] microbatched input, replicated over
+    the pp axis (only stage 0 reads it).
+    """
+    # The pp-sharded stacked params arrive as a [1, ...] block per device.
+    params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    n = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    first = stage == 0
+    last = stage == n - 1
+    m = num_microbatches
+
+    # Forward neighbor ring: stage s sends to s+1; the wrap edge (n-1 -> 0)
+    # carries only garbage (tick indices where stage 0 reads fresh input).
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    out0 = jax.tree.map(jnp.zeros_like, x)
+    carry_in0 = jax.tree.map(lambda a: jnp.zeros(a.shape[1:], a.dtype), x)
+
+    def tick(carry, t):
+        carry_in, out = carry
+        # Stage 0 consumes microbatch t while t < m (clamped index keeps
+        # shapes static; the result past m is garbage that never reaches
+        # the output buffer of a valid tick).
+        ti = jnp.minimum(t, m - 1)
+        mb = jax.tree.map(lambda a: a[ti], x)
+        stage_in = jax.tree.map(
+            lambda a, b: jnp.where(first, a, b), mb, carry_in
+        )
+        y = stage_fn(params, stage_in)
+        # Last stage's output for microbatch t - (n-1) is valid at tick t
+        # >= n-1; everyone else writes into a buffer that is masked out of
+        # the psum below.
+        out_idx = jnp.clip(t - (n - 1), 0, m - 1)
+        valid = jnp.logical_and(last, t >= n - 1)
+
+        def write(buf, val):
+            prev = jax.lax.dynamic_index_in_dim(buf, out_idx, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(valid, val, prev), out_idx, 0
+            )
+
+        out = jax.tree.map(write, out, y)
+        carry_next = jax.lax.ppermute(y, axis_name, perm)
+        return (carry_next, out), None
+
+    (_, out), _ = jax.lax.scan(
+        tick, (carry_in0, out0), jnp.arange(num_ticks(n, m))
+    )
+    # Only the last stage holds real outputs; broadcast them to every pp
+    # slot so downstream (loss, data-parallel reductions) sees the full
+    # batch everywhere. Output is activation-sized — one hop around the pp
+    # ring, cheap next to the per-tick traffic.
+    return jax.tree.map(
+        lambda o: jax.lax.psum(
+            jnp.where(last, o, jnp.zeros_like(o)), axis_name
+        ),
+        out,
+    )
+
+
+def pipeline(
+    stage_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    x: Any,
+    *,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = AXIS_PIPE,
+    batch_spec: P = P(),
+) -> Any:
+    """Run `x` through a pipeline of stages spread over the `axis_name`
+    mesh axis.
+
+    - ``stage_fn(stage_params, x) -> y`` with ``y`` matching ``x``'s
+      pytree structure and shapes (homogeneous stages — transformer
+      blocks; side inputs like attention masks ride the pytree: pass
+      ``(hidden, mask)`` and return ``(new_hidden, mask)``);
+    - ``stacked_params``: pytree with leading dim ``num_stages ==
+      mesh.shape[axis_name]`` (see `stack_pytrees`), sharded over `pp`;
+    - ``x``: pytree of [batch, ...] arrays; batch must divide by
+      ``num_microbatches``;
+    - ``batch_spec``: PartitionSpec entry for x's batch dim (e.g.
+      ``P(('dp','fsdp'))`` when composing with data parallelism — the
+      microbatch split then happens per data shard).
+
+    Without a mesh (or with pp=1) this degenerates to sequentially folding
+    the stages — numerically identical, so the same model code runs
+    single-device.
+    """
+    from tpudl.parallel.sharding import current_mesh
+
+    if mesh is None:
+        mesh = current_mesh()
+    n_stages = mesh.shape[axis_name] if mesh is not None else 1
+    if n_stages == 1:
+        n = jax.tree.leaves(stacked_params)[0].shape[0]
+        y = x
+        for i in range(n):
+            y = stage_fn(jax.tree.map(lambda p: p[i], stacked_params), y)
+        return y
+
+    leaves = jax.tree.leaves(x)
+    batch = leaves[0].shape[0]
+    if any(l.shape[0] != batch for l in leaves):
+        raise ValueError(
+            f"all x leaves must share the batch dim; got "
+            f"{[l.shape for l in leaves]}"
+        )
+    if batch % num_microbatches != 0:
+        raise ValueError(
+            f"batch {batch} not divisible by num_microbatches={num_microbatches}"
+        )
+    leading = jax.tree.leaves(stacked_params)[0].shape[0]
+    if leading != n_stages:
+        raise ValueError(
+            f"stacked_params leading dim {leading} != mesh {axis_name} size "
+            f"{n_stages} (one stage per pp slot)"
+        )
+
+    mb = batch // num_microbatches
+    n_batch_shards = 1
+    for entry in batch_spec:
+        for ax in entry if isinstance(entry, tuple) else (entry,):
+            n_batch_shards *= mesh.shape[ax]
+    if mb % n_batch_shards != 0:
+        raise ValueError(
+            f"microbatch size {mb} (batch {batch} / num_microbatches="
+            f"{num_microbatches}) not divisible by the {batch_spec} mesh "
+            f"extent {n_batch_shards}"
+        )
+    xm = jax.tree.map(
+        lambda a: a.reshape((num_microbatches, mb) + a.shape[1:]), x
+    )
+
+    param_specs = jax.tree.map(
+        lambda p: stage_param_spec(p.ndim, axis_name), stacked_params
+    )
+    # Microbatched input: the original batch dim is now dim 1.
+    x_specs = jax.tree.map(
+        lambda a: P(None, *batch_spec, *([None] * (a.ndim - 2))), xm
+    )
+
+    fn = jax.shard_map(
+        partial(
+            _pipeline_local,
+            stage_fn=stage_fn,
+            axis_name=axis_name,
+            num_microbatches=num_microbatches,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, x_specs),
+        out_specs=x_specs,
+        check_vma=False,
+    )
+    out = fn(stacked_params, xm)
+    return jax.tree.map(
+        lambda a: a.reshape((batch,) + a.shape[2:]), out
+    )

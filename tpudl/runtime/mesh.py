@@ -6,7 +6,7 @@ BASELINE.json `north_star`; the reference itself ships no communication
 backend: the only device-boundary ops in the whole tree are host<->device
 copies at notebooks/cv/onnx_experiments.py:69-72,93).
 
-Design: one logical 4-axis mesh covers every parallelism strategy the
+Design: one logical 6-axis mesh covers every parallelism strategy the
 framework supports. Unused axes have size 1 and cost nothing:
 
 - ``dp``   — pure data parallelism (gradients psum'd over ICI).
@@ -16,6 +16,11 @@ framework supports. Unused axes have size 1 and cost nothing:
              sequence axis; ring attention moves K/V blocks via ppermute).
 - ``tp``   — tensor (model) parallelism (contracting-dim sharding of
              matmuls; XLA inserts all-reduce/reduce-scatter).
+- ``pp``   — pipeline parallelism (layer stages spread over devices;
+             activations hop stage-to-stage via ppermute —
+             tpudl.parallel.pipeline).
+- ``ep``   — expert parallelism (MoE expert weights sharded over the
+             expert dim; token dispatch rides all-to-all).
 
 Shardings are expressed as ``PartitionSpec``s over these names; XLA/GSPMD
 lowers them to ICI collectives inside the compiled step (no Python in the
@@ -38,9 +43,18 @@ AXIS_DATA = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_SEQ = "sp"
 AXIS_TENSOR = "tp"
+AXIS_PIPE = "pp"
+AXIS_EXPERT = "ep"
 
 #: Canonical axis order of every tpudl mesh.
-MESH_AXES: tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES: tuple[str, ...] = (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    AXIS_PIPE,
+    AXIS_EXPERT,
+)
 
 #: Axes over which the global batch is split (data-like axes).
 BATCH_AXES: tuple[str, ...] = (AXIS_DATA, AXIS_FSDP)
@@ -55,9 +69,11 @@ class MeshSpec:
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
+    ep: int = 1
 
-    def resolve(self, num_devices: int) -> tuple[int, int, int, int]:
-        sizes = [self.dp, self.fsdp, self.sp, self.tp]
+    def resolve(self, num_devices: int) -> tuple[int, ...]:
+        sizes = [self.dp, self.fsdp, self.sp, self.tp, self.pp, self.ep]
         wild = [i for i, s in enumerate(sizes) if s == -1]
         if len(wild) > 1:
             raise ValueError(f"At most one wildcard (-1) axis allowed, got {sizes}")
@@ -83,7 +99,7 @@ def make_mesh(
     spec: MeshSpec | Sequence[int] | None = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a 4-axis ``Mesh`` (dp, fsdp, sp, tp) over ``devices``.
+    """Build a 6-axis ``Mesh`` (dp, fsdp, sp, tp, pp, ep) over ``devices``.
 
     Uses ``mesh_utils.create_device_mesh`` so that on real TPU slices the
     mesh axes are laid out along the physical ICI torus (nearest-neighbor
