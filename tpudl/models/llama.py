@@ -44,6 +44,11 @@ class LlamaConfig:
     remat: bool = False
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # MoE (tpudl.ops.moe): >0 swaps the dense SwiGLU MLP for an
+    # expert-parallel gated MoE in every block.
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -163,9 +168,23 @@ class LlamaBlock(nn.Module):
         )
         hidden = hidden + attn
         x = RMSNorm(cfg.rms_norm_eps, name="post_attention_norm")(hidden)
-        gate = _proj(cfg, cfg.intermediate_size, "gate_proj")(x)
-        up = _proj(cfg, cfg.intermediate_size, "up_proj")(x)
-        down = _proj(cfg, cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
+        if cfg.moe_experts > 0:
+            from tpudl.ops.moe import MoEMlp
+
+            down = MoEMlp(
+                num_experts=cfg.moe_experts,
+                intermediate_size=cfg.intermediate_size,
+                k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                gated=True,
+                act=nn.silu,
+                dtype=cfg.dtype,
+                name="moe",
+            )(x)
+        else:
+            gate = _proj(cfg, cfg.intermediate_size, "gate_proj")(x)
+            up = _proj(cfg, cfg.intermediate_size, "up_proj")(x)
+            down = _proj(cfg, cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
         hidden = hidden + down
         return constrain(hidden, ("dp", "fsdp"), "sp", "tp")
 
@@ -240,15 +259,26 @@ class LlamaForSequenceClassification(nn.Module):
 
 
 def build_llama(name: str, num_classes: int, dtype=jnp.bfloat16, **kwargs):
-    """Registry entry: 'llama-tiny' / 'llama3-8b', with a '-lora' suffix
-    enabling rank-16 adapters (override via lora_rank=)."""
-    base = name.removesuffix("-lora")
-    lora = name.endswith("-lora")
+    """Registry entry: 'llama-tiny' / 'llama3-8b', with composable
+    suffixes: '-lora' enables rank-16 adapters (override via lora_rank=),
+    '-moe' swaps every MLP for an 8-expert MoE (override via
+    moe_experts=)."""
+    base = name
+    lora = moe = False
+    while True:
+        if base.endswith("-lora"):
+            base, lora = base.removesuffix("-lora"), True
+        elif base.endswith("-moe"):
+            base, moe = base.removesuffix("-moe"), True
+        else:
+            break
     if base not in LLAMA_SIZES:
         raise ValueError(
             f"unknown llama size {base!r}; available: {sorted(LLAMA_SIZES)}"
         )
     if lora:
         kwargs.setdefault("lora_rank", 16)
+    if moe:
+        kwargs.setdefault("moe_experts", 8)
     cfg = LLAMA_SIZES[base](num_labels=num_classes, dtype=dtype, **kwargs)
     return LlamaForSequenceClassification(cfg)

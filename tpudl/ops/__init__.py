@@ -1,8 +1,9 @@
-"""TPU attention ops behind one dispatch seam (tpudl.ops.attend):
+"""TPU ops: attention behind one dispatch seam, and expert-parallel MoE.
 
 - attention.py        — reference einsum attention (+ masks, dropout);
 - flash_attention.py  — Pallas fused online-softmax kernel, fwd + bwd;
-- ring_attention.py   — sequence-parallel ring attention over `sp`.
+- ring_attention.py   — sequence-parallel ring attention over `sp`;
+- moe.py              — top-k routed expert FFN over `ep` (all-to-all).
 """
 
 from tpudl.ops.attention import (  # noqa: F401
@@ -10,4 +11,11 @@ from tpudl.ops.attention import (  # noqa: F401
     causal_mask,
     dot_product_attention,
     padding_mask,
+)
+from tpudl.ops.moe import (  # noqa: F401
+    EP_MOE_RULES,
+    MoEMlp,
+    expert_capacity,
+    route_topk,
+    with_moe_rules,
 )

@@ -1,0 +1,158 @@
+"""Mixture-of-experts FFN with expert parallelism over the ``ep`` mesh axis.
+
+The reference lineage has no MoE (SURVEY.md §2.3 marks expert parallelism
+absent from the reference tree); this makes sparse scaling first-class the
+TPU way: routing is dense one-hot einsum algebra (GShard/Switch style) —
+no gather/scatter, no dynamic shapes — so the dispatch/combine contractions
+lower onto the MXU, and with expert weights sharded ``P('ep', ...)`` and
+tokens sharded over (dp, fsdp), GSPMD inserts the all-to-all that moves
+token blocks to their experts over ICI.
+
+Routing math (top-k, capacity-bounded):
+- router probs p = softmax(x @ w_r) in f32;
+- k choices peeled off iteratively (argmax, mask, renormalize) with
+  earlier choices taking dispatch priority;
+- position_in_expert via cumsum over the token axis; tokens past an
+  expert's capacity ``C = ceil(k * S * capacity_factor / E)`` are dropped
+  (their combine weight is zero — the residual connection around the MoE
+  layer carries them through unchanged);
+- gate values renormalized over the kept top-k so combine weights sum to
+  at most 1 per token;
+- Switch-style load-balance aux loss ``E * sum_e f_e * p_e`` (f = top-1
+  dispatch fraction, p = mean router prob), sown into the
+  ``intermediates`` collection as ``moe_aux_loss`` for the train loop to
+  pick up (tpudl.train.loop.make_classification_train_step
+  ``moe_aux_weight``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpudl.parallel.sharding import constrain
+
+P = jax.sharding.PartitionSpec
+
+#: Sharding rules for MoE parameters, composable ahead of FSDP/TP rules:
+#: expert dim over ep, then the usual megatron column/row split.
+EP_MOE_RULES = (
+    (r"(^|/)router/kernel$", P(None, None)),
+    (r"(^|/)(wi|wg)$", P("ep", "fsdp", "tp")),
+    (r"(^|/)wo$", P("ep", "tp", "fsdp")),
+)
+
+
+def with_moe_rules(base) -> tuple:
+    """Prepend the MoE expert rules to a base rule list (first match wins,
+    so expert params resolve before the generic kernel rules)."""
+    return tuple(EP_MOE_RULES) + tuple(base or ())
+
+
+def expert_capacity(
+    seq_len: int, num_experts: int, k: int, capacity_factor: float
+) -> int:
+    return max(1, math.ceil(k * seq_len * capacity_factor / num_experts))
+
+
+def route_topk(probs: jax.Array, k: int, capacity: int):
+    """Build dispatch/combine tensors from router probabilities.
+
+    probs: [G, S, E] f32 (softmax over E). Returns
+    ``(dispatch [G,S,E,C] bool-ish f32, combine [G,S,E,C] f32, aux f32)``.
+    """
+    g, s, e = probs.shape
+    top1_mask = jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
+
+    remaining = probs
+    counts = jnp.zeros((g, 1, e), probs.dtype)
+    dispatch = jnp.zeros((g, s, e, capacity), probs.dtype)
+    gate_total = jnp.zeros((g, s), probs.dtype)
+    combine = jnp.zeros((g, s, e, capacity), probs.dtype)
+
+    for _ in range(k):
+        idx = jnp.argmax(remaining, -1)  # [G, S]
+        gate = jnp.max(remaining, -1)  # [G, S]
+        mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)  # [G, S, E]
+        # 0-based slot of each token within its expert, counting earlier
+        # choices' kept assignments first (they have priority).
+        pos = jnp.cumsum(mask, axis=1) - mask + counts  # [G, S, E]
+        keep = (pos < capacity).astype(probs.dtype) * mask
+        counts = counts + jnp.sum(keep, axis=1, keepdims=True)
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * mask, -1).astype(jnp.int32), capacity,
+            dtype=probs.dtype,
+        )  # [G, S, C]
+        disp = keep[..., None] * slot[:, :, None, :]  # [G, S, E, C]
+        dispatch = dispatch + disp
+        kept_gate = gate * jnp.sum(keep, -1)
+        combine = combine + disp * gate[..., None, None]
+        gate_total = gate_total + kept_gate
+        remaining = remaining * (1.0 - mask)
+
+    combine = combine / jnp.maximum(gate_total, 1e-9)[..., None, None]
+
+    # Switch load-balance loss: E * sum_e (top-1 dispatch fraction) *
+    # (mean router prob). 1.0 at perfect balance.
+    f = jnp.mean(top1_mask, axis=(0, 1))
+    p = jnp.mean(probs, axis=(0, 1))
+    aux = e * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel FFN block (drop-in for a dense MLP of the same
+    hidden/intermediate sizes; callers keep their residual connection, so
+    capacity-dropped tokens pass through unchanged).
+
+    ``gated=True`` gives the SwiGLU variant (Llama-style); otherwise a
+    plain act(x@wi)@wo (BERT-style).
+    """
+
+    num_experts: int
+    intermediate_size: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    gated: bool = False
+    act: Callable = nn.gelu
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, m = x.shape
+        e, h = self.num_experts, self.intermediate_size
+        cap = expert_capacity(s, e, self.k, self.capacity_factor)
+
+        # Router in f32: small matmul, and routing decisions are
+        # precision-sensitive.
+        logits = nn.Dense(
+            e,
+            use_bias=False,
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.02),
+            name="router",
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, aux = route_topk(probs, self.k, cap)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        init = nn.initializers.normal(0.02)
+        wi = self.param("wi", init, (e, m, h)).astype(self.dtype)
+        wo = self.param("wo", init, (e, h, m)).astype(self.dtype)
+
+        xin = jnp.einsum("gsec,gsm->egcm", dispatch.astype(self.dtype), x)
+        xin = constrain(xin, "ep", ("dp", "fsdp"), None, None)
+        hh = jnp.einsum("egcm,emh->egch", xin, wi)
+        if self.gated:
+            wg = self.param("wg", init, (e, m, h)).astype(self.dtype)
+            hh = self.act(hh) * jnp.einsum("egcm,emh->egch", xin, wg)
+        else:
+            hh = self.act(hh)
+        out = jnp.einsum("egch,ehm->egcm", hh, wo)
+        out = constrain(out, "ep", ("dp", "fsdp"), None, None)
+        y = jnp.einsum("gsec,egcm->gsm", combine.astype(self.dtype), out)
+        return y

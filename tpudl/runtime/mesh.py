@@ -20,7 +20,8 @@ framework supports. Unused axes have size 1 and cost nothing:
              activations hop stage-to-stage via ppermute —
              tpudl.parallel.pipeline).
 - ``ep``   — expert parallelism (MoE expert weights sharded over the
-             expert dim; token dispatch rides all-to-all).
+             expert dim; token dispatch rides all-to-all —
+             tpudl.ops.moe).
 
 Shardings are expressed as ``PartitionSpec``s over these names; XLA/GSPMD
 lowers them to ICI collectives inside the compiled step (no Python in the

@@ -37,7 +37,9 @@ def create_train_state(
     tx: optax.GradientTransformation,
     init_kwargs: Optional[dict] = None,
 ) -> TrainState:
-    variables = model.init(rng, sample_input, **(init_kwargs or {"train": False}))
+    if init_kwargs is None:
+        init_kwargs = {"train": False}
+    variables = model.init(rng, sample_input, **init_kwargs)
     return TrainState.create(
         apply_fn=model.apply,
         params=variables["params"],
@@ -62,6 +64,7 @@ def make_classification_train_step(
     label_smoothing: float = 0.0,
     input_keys: "str | tuple" = ("image",),
     label_key: str = "label",
+    moe_aux_weight: float = 0.0,
 ) -> Callable:
     """Train step for image/sequence classification models.
 
@@ -72,9 +75,24 @@ def make_classification_train_step(
     statistics) have global semantics under pjit: with the batch sharded
     over (dp, fsdp) they compile to ICI collectives — synchronized BN and
     gradient all-reduce with zero framework code.
+
+    ``moe_aux_weight`` > 0 adds the MoE load-balance losses the model's
+    MoE layers sowed as ``moe_aux_loss`` (tpudl.ops.moe.MoEMlp) into the
+    objective, and reports their sum as the ``moe_aux`` metric.
     """
     if isinstance(input_keys, str):
         input_keys = (input_keys,)
+
+    def _sown_aux(mutated: dict) -> jax.Array:
+        """Sum only the sown ``moe_aux_loss`` entries (other intermediates
+        — diagnostic probes — must not leak into the objective)."""
+        total = jnp.zeros((), jnp.float32)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            mutated.get("intermediates", {})
+        ):
+            if "moe_aux_loss" in jax.tree_util.keystr(path):
+                total = total + jnp.sum(leaf)
+        return total
 
     def step(state: TrainState, batch: dict, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)
@@ -82,25 +100,35 @@ def make_classification_train_step(
 
         def loss_fn(params):
             variables = {"params": params}
+            mutable = []
             if state.batch_stats is not None:
                 variables["batch_stats"] = state.batch_stats
+                mutable.append("batch_stats")
+            if moe_aux_weight > 0.0:
+                mutable.append("intermediates")
+            if mutable:
                 outputs, mutated = state.apply_fn(
                     variables,
                     *inputs,
                     train=True,
-                    mutable=["batch_stats"],
+                    mutable=mutable,
                     rngs={"dropout": step_rng},
                 )
-                new_stats = mutated["batch_stats"]
+                new_stats = mutated.get("batch_stats")
             else:
                 outputs = state.apply_fn(
                     variables, *inputs, train=True, rngs={"dropout": step_rng}
                 )
+                mutated = {}
                 new_stats = None
             loss = cross_entropy_loss(outputs, batch[label_key], label_smoothing)
-            return loss, (outputs, new_stats)
+            aux = None
+            if moe_aux_weight > 0.0:
+                aux = _sown_aux(mutated)
+                loss = loss + moe_aux_weight * aux
+            return loss, (outputs, new_stats, aux)
 
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+        (loss, (logits, new_stats, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
         new_state = state.apply_gradients(grads=grads)
@@ -110,6 +138,8 @@ def make_classification_train_step(
             "loss": loss,
             "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch[label_key]),
         }
+        if aux is not None:
+            metrics["moe_aux"] = aux
         return new_state, metrics
 
     return step
