@@ -3,7 +3,9 @@
 from tpudl.runtime.distributor import TpuDistributor  # noqa: F401
 from tpudl.runtime.mesh import (  # noqa: F401
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_PIPE,
     AXIS_SEQ,
     AXIS_TENSOR,
     MESH_AXES,
@@ -11,3 +13,4 @@ from tpudl.runtime.mesh import (  # noqa: F401
     batch_partition_spec,
     make_mesh,
 )
+from tpudl.runtime.rng import use_hardware_rng  # noqa: F401
