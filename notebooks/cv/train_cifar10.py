@@ -59,19 +59,25 @@ def main():
     )
 
     if args.data_dir:
+        from tpudl.data.augment import BatchAugmenter
         from tpudl.data.converter import make_converter, prefetch_to_device
-        from tpudl.data.datasets import materialize_cifar10_like, normalize_cifar_batch
+        from tpudl.data.datasets import materialize_cifar10_like
 
         if args.materialize:
             conv = materialize_cifar10_like(args.data_dir, num_rows=50_000)
         else:
             conv = make_converter(args.data_dir)
+        # Standard CIFAR training augmentation (pad-4 random crop + flip +
+        # normalize), fused in the native C++ kernel when available
+        # (tpudl/native/augment.cpp; numpy fallback otherwise).
+        augment = BatchAugmenter(
+            crop=(cfg.image_size, cfg.image_size), pad=4, seed=cfg.seed
+        )
         raw = conv.make_batch_iterator(
-            batch_size, epochs=None, shuffle=True, seed=cfg.seed
+            batch_size, epochs=None, shuffle=True, seed=cfg.seed,
+            transform=augment,
         )
-        batches = prefetch_to_device(
-            (normalize_cifar_batch(b) for b in raw), mesh=mesh
-        )
+        batches = prefetch_to_device(raw, mesh=mesh)
     else:
         batches = synthetic_classification_batches(
             batch_size,

@@ -1,0 +1,175 @@
+"""Native C++ augmentation kernel vs the numpy fallback (tpudl.data.augment).
+
+The backend-parity strategy mirrors the repo's cross-backend parity
+doctrine (SURVEY.md §3.3): same inputs, same random draws, two
+implementations, outputs compared numerically.
+"""
+
+import numpy as np
+import pytest
+
+from tpudl.data.augment import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    BatchAugmenter,
+    _augment_numpy,
+    _normalize_numpy,
+)
+from tpudl.native import load_library
+
+N, H, W, C = 16, 32, 32, 3
+
+
+def _images(seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=(N, H, W, C), dtype=np.uint8
+    )
+
+
+def test_numpy_geometry_no_pad_no_flip_identity():
+    """With pad=0, full-size crop, no flip, the transform is pure
+    normalization."""
+    imgs = _images()
+    offsets = np.zeros((N, 2), np.int32)
+    flip = np.zeros(N, np.uint8)
+    mean = np.asarray(CIFAR10_MEAN, np.float32)
+    std = np.asarray(CIFAR10_STD, np.float32)
+    out = _augment_numpy(imgs, 0, H, W, offsets, flip, mean, std)
+    expected = (imgs.astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+def test_numpy_flip_mirrors_columns():
+    imgs = _images()
+    offsets = np.zeros((N, 2), np.int32)
+    mean = np.zeros(3, np.float32)
+    std = np.ones(3, np.float32)
+    out_f = _augment_numpy(
+        imgs, 0, H, W, offsets, np.ones(N, np.uint8), mean, std
+    )
+    out = _augment_numpy(
+        imgs, 0, H, W, offsets, np.zeros(N, np.uint8), mean, std
+    )
+    np.testing.assert_allclose(out_f, out[:, :, ::-1, :], atol=0)
+
+
+def test_numpy_padding_is_zero_pixels():
+    """Offset (0, 0) with pad=4 exposes 4 rows/cols of zero padding."""
+    imgs = _images()
+    mean = np.zeros(3, np.float32)
+    std = np.ones(3, np.float32)
+    out = _augment_numpy(
+        imgs, 4, H, W, np.zeros((N, 2), np.int32), np.zeros(N, np.uint8),
+        mean, std,
+    )
+    np.testing.assert_allclose(out[:, :4, :, :], 0.0, atol=0)
+    np.testing.assert_allclose(out[:, :, :4, :], 0.0, atol=0)
+    np.testing.assert_allclose(
+        out[:, 4:, 4:, :],
+        imgs[:, : H - 4, : W - 4, :].astype(np.float32) / 255.0,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.skipif(load_library() is None, reason="no native toolchain")
+class TestNativeParity:
+    def test_augment_matches_numpy(self):
+        imgs = _images(1)
+        rng = np.random.default_rng(7)
+        pad, ch, cw = 4, 32, 32
+        offsets = np.stack(
+            [rng.integers(0, 9, N), rng.integers(0, 9, N)], axis=1
+        ).astype(np.int32)
+        flip = (rng.random(N) < 0.5).astype(np.uint8)
+        mean = np.asarray(CIFAR10_MEAN, np.float32)
+        std = np.asarray(CIFAR10_STD, np.float32)
+
+        expected = _augment_numpy(imgs, pad, ch, cw, offsets, flip, mean, std)
+
+        import ctypes
+
+        lib = load_library()
+        out = np.empty((N, ch, cw, C), np.float32)
+        lib.tpudl_augment_batch(
+            imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            N, H, W, C, pad, ch, cw,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            flip.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        np.testing.assert_allclose(out, expected, atol=1e-6)
+
+    def test_augmenter_backends_agree_end_to_end(self):
+        """Same seed => same random draws => same output either backend."""
+        imgs = _images(2)
+        a_native = BatchAugmenter(seed=3, backend="native")
+        a_numpy = BatchAugmenter(seed=3, backend="numpy")
+        assert a_native.backend == "native"
+        assert a_numpy.backend == "numpy"
+        out_n = a_native({"image": imgs, "label": np.arange(N)})
+        out_p = a_numpy({"image": imgs, "label": np.arange(N)})
+        np.testing.assert_allclose(out_n["image"], out_p["image"], atol=1e-6)
+        np.testing.assert_array_equal(out_n["label"], np.arange(N))
+        assert out_n["image"].dtype == np.float32
+
+    def test_center_crop_eval_path(self):
+        imgs = _images(3)
+        a = BatchAugmenter(
+            crop=(24, 24), train=False, backend="native",
+        )
+        expected = _normalize_numpy(
+            imgs, 24, 24,
+            np.asarray(CIFAR10_MEAN, np.float32),
+            np.asarray(CIFAR10_STD, np.float32),
+        )
+        np.testing.assert_allclose(a(imgs), expected, atol=1e-6)
+
+
+def test_augmenter_through_converter(tmp_path):
+    """transform= hook: the converter yields augmented f32 batches."""
+    from tpudl.data.datasets import materialize_cifar10_like
+
+    conv = materialize_cifar10_like(
+        str(tmp_path), num_rows=256, rows_per_file=128
+    )
+    aug = BatchAugmenter(seed=0, backend="auto")
+    it = conv.make_batch_iterator(
+        batch_size=64,
+        shard_index=0,
+        num_shards=1,
+        transform=aug,
+    )
+    batch = next(it)
+    assert batch["image"].dtype == np.float32
+    assert batch["image"].shape == (64, 32, 32, 3)
+    # Normalized stats: roughly zero-mean, unit-ish variance.
+    assert abs(float(batch["image"].mean())) < 1.0
+    assert 0.2 < float(batch["image"].std()) < 3.0
+
+
+def test_wide_channel_images_take_numpy_path():
+    """The native kernel caps at 16 channels; wider images must fall back
+    (not read uninitialized memory)."""
+    imgs = np.random.default_rng(0).integers(
+        0, 256, size=(4, 8, 8, 32), dtype=np.uint8
+    )
+    mean = tuple([0.5] * 32)
+    std = tuple([0.5] * 32)
+    a_auto = BatchAugmenter(
+        crop=(8, 8), pad=2, seed=5, mean=mean, std=std, backend="auto"
+    )
+    a_np = BatchAugmenter(
+        crop=(8, 8), pad=2, seed=5, mean=mean, std=std, backend="numpy"
+    )
+    np.testing.assert_allclose(a_auto(imgs), a_np(imgs), atol=0)
+
+
+def test_augmenter_rejects_bad_input():
+    with pytest.raises(ValueError, match="uint8"):
+        BatchAugmenter(backend="numpy")(np.zeros((2, 32, 32, 3), np.float32))
+    with pytest.raises(ValueError, match="channels"):
+        BatchAugmenter(backend="numpy", mean=(0.5,), std=(0.5,))(
+            np.zeros((2, 32, 32, 3), np.uint8)
+        )

@@ -27,7 +27,7 @@ import math
 import os
 import queue
 import threading
-from typing import Dict, Iterator, List, Optional, Sequence
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -143,6 +143,7 @@ class Converter:
         num_shards: Optional[int] = None,
         columns: Optional[Sequence[str]] = None,
         shuffle_buffer: int = 8192,
+        transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
     ) -> Iterator[Dict[str, np.ndarray]]:
         """Yield batches for this process's shard.
 
@@ -152,6 +153,9 @@ class Converter:
         on every process (at most num_shards-1 rows per file are dropped).
         Defaults come from the JAX process topology exactly like
         Petastorm's cur_shard/shard_count.
+
+        ``transform`` (e.g. tpudl.data.augment.BatchAugmenter) is applied
+        to each assembled batch on the host, before device transfer.
         """
         if shard_index is None or num_shards is None:
             import jax
@@ -164,7 +168,7 @@ class Converter:
         epoch = 0
         while epochs is None or epoch < epochs:
             rng = np.random.default_rng(seed + epoch) if shuffle else None
-            yield from self._epoch_batches(
+            batches = self._epoch_batches(
                 batch_size,
                 rng,
                 shard_index,
@@ -173,6 +177,9 @@ class Converter:
                 columns,
                 shuffle_buffer,
             )
+            if transform is not None:
+                batches = map(transform, batches)
+            yield from batches
             epoch += 1
 
     def _shard_chunks(self, rng, shard_index, num_shards, columns):

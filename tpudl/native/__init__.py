@@ -1,0 +1,90 @@
+"""Native (C++) data-path kernels, loaded via ctypes.
+
+The reference lineage's input pipeline runs per-image work in native code
+(torchvision transforms drive libtorch C++ — reference
+notebooks/cv/onnx_experiments.py:55-66); tpudl's equivalent lives in
+augment.cpp and is consumed through tpudl.data.augment.BatchAugmenter,
+which falls back to a numpy implementation equal to f32 rounding when no
+C++ toolchain is available — the native layer accelerates, never
+changes, training.
+
+Build: `make -C tpudl/native`, or `load_library()` builds lazily with g++
+on first use (cached as libtpudl_data.so next to the sources).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_log = logging.getLogger("tpudl.native")
+_dir = os.path.dirname(os.path.abspath(__file__))
+_so_path = os.path.join(_dir, "libtpudl_data.so")
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None | bool" = None  # None = untried, False = failed
+
+
+def _build() -> bool:
+    src = os.path.join(_dir, "augment.cpp")
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3",
+        "-fPIC",
+        "-fopenmp",
+        "-shared",
+        "-o",
+        _so_path,
+        src,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        _log.warning("native build failed (%s); using numpy fallback", detail)
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The native kernel library, building it if needed. None when neither
+    a prebuilt .so nor a working compiler is available (callers fall back
+    to numpy)."""
+    global _lib
+    with _lock:
+        if _lib is None:
+            src = os.path.join(_dir, "augment.cpp")
+            stale = os.path.exists(_so_path) and os.path.getmtime(
+                _so_path
+            ) < os.path.getmtime(src)
+            if (not os.path.exists(_so_path) or stale) and not _build():
+                _lib = False
+            else:
+                try:
+                    lib = ctypes.CDLL(_so_path)
+                    _configure(lib)
+                    _lib = lib
+                except OSError as e:
+                    _log.warning("failed to load %s: %s", _so_path, e)
+                    _lib = False
+        return _lib or None
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64 = ctypes.c_int64
+    lib.tpudl_augment_batch.restype = None
+    lib.tpudl_augment_batch.argtypes = [
+        u8p, i64, i64, i64, i64, i64, i64, i64, i32p, u8p, f32p, f32p, f32p,
+    ]
+    lib.tpudl_normalize_batch.restype = None
+    lib.tpudl_normalize_batch.argtypes = [
+        u8p, i64, i64, i64, i64, i64, i64, f32p, f32p, f32p,
+    ]
