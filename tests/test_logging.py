@@ -9,7 +9,35 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import pytest
+
 from tpudl.train import MetricLogger
+
+
+@pytest.fixture(scope="module")
+def tiny_cv_step():
+    """(state, compiled step) for a tiny ResNet — shared across the fit()
+    integration tests (compiling ResNet18 on CPU is the slow part)."""
+    from tpudl.models import ResNet18
+    from tpudl.runtime import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        make_classification_train_step,
+    )
+
+    model = ResNet18(num_classes=10, small_inputs=True)
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, 16, 16, 3)),
+        optax.sgd(0.1),
+    )
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(
+        make_classification_train_step(), mesh, state, None, donate_state=False
+    )
+    return state, step
 
 
 def test_jsonl_sink(tmp_path):
@@ -44,27 +72,12 @@ def test_stdlog_only_no_dir(caplog):
     assert "step=3" in caplog.text and "loss=0.125" in caplog.text
 
 
-def test_as_fit_logger_callback(tmp_path):
+def test_as_fit_logger_callback(tmp_path, tiny_cv_step):
     """MetricLogger plugs straight into fit(logger=...)."""
     from tpudl.data.synthetic import synthetic_classification_batches
-    from tpudl.models import ResNet18
-    from tpudl.runtime import MeshSpec, make_mesh
-    from tpudl.train import (
-        compile_step,
-        create_train_state,
-        fit,
-        make_classification_train_step,
-    )
+    from tpudl.train import fit
 
-    model = ResNet18(num_classes=10, small_inputs=True)
-    state = create_train_state(
-        jax.random.key(0),
-        model,
-        jnp.zeros((1, 16, 16, 3)),
-        optax.sgd(0.1),
-    )
-    mesh = make_mesh(MeshSpec(dp=-1))
-    step = compile_step(make_classification_train_step(), mesh, state, None)
+    state, step = tiny_cv_step
     d = str(tmp_path / "fitlog")
     with MetricLogger(d, tensorboard=False) as ml:
         fit(
@@ -80,3 +93,28 @@ def test_as_fit_logger_callback(tmp_path):
     lines = [json.loads(x) for x in open(os.path.join(d, "metrics.jsonl"))]
     assert [x["step"] for x in lines] == [2, 4]
     assert all(np.isfinite(x["loss"]) for x in lines)
+
+
+def test_fit_profiler_hook_writes_trace(tmp_path, tiny_cv_step):
+    """fit(profile_dir=...) captures the configured step window with
+    jax.profiler and leaves a TensorBoard-readable trace on disk
+    (SURVEY.md §5.1)."""
+    from tpudl.data.synthetic import synthetic_classification_batches
+    from tpudl.train import fit
+
+    state, step = tiny_cv_step
+    prof_dir = str(tmp_path / "trace")
+    fit(
+        step,
+        state,
+        synthetic_classification_batches(8, image_shape=(16, 16, 3), num_batches=6),
+        jax.random.key(1),
+        profile_dir=prof_dir,
+        profile_window=(1, 3),
+    )
+    trace_files = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(prof_dir)
+        for f in files
+    ]
+    assert trace_files, "profiler wrote no trace files"
