@@ -1,0 +1,39 @@
+import pathlib as _pathlib, sys as _sys
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
+import sys, time
+import jax, jax.numpy as jnp, optax
+from tpudl.data.synthetic import synthetic_token_batches
+from tpudl.models.bert import BertConfig, BertForSequenceClassification
+from tpudl.runtime import MeshSpec, make_mesh
+from tpudl.train import compile_step, create_train_state, make_classification_train_step
+from tpudl.train.metrics import device_peak_flops, mfu, transformer_train_flops
+
+SEQ = 128
+IMPL = sys.argv[1]; DROP = float(sys.argv[2])
+mesh = make_mesh(MeshSpec(dp=-1))
+cfg = BertConfig(attention_impl=IMPL, hidden_dropout=DROP, attention_dropout=DROP)
+model = BertForSequenceClassification(cfg)
+state0 = create_train_state(jax.random.key(0), model,
+                            jnp.zeros((1, SEQ), jnp.int32),
+                            optax.adamw(2e-5, weight_decay=0.01))
+n_params = sum(p.size for p in jax.tree.leaves(state0.params))
+for b in (int(x) for x in sys.argv[3].split(',')):
+    state = state0
+    step = compile_step(make_classification_train_step(
+        input_keys=("input_ids","attention_mask"), label_key="label"),
+        mesh, state, None, donate_state=False)
+    batch = jax.device_put(next(synthetic_token_batches(b, seq_len=SEQ, vocab_size=30_522)))
+    rng = jax.random.key(1)
+    flops = transformer_train_flops(n_params, b*SEQ)
+    for _ in range(10):
+        state, m = step(state, batch, rng)
+    float(m["loss"])
+    t0 = time.perf_counter(); N = 20
+    for _ in range(N):
+        state, m = step(state, batch, rng)
+    float(m["loss"])
+    dt = (time.perf_counter()-t0)/N
+    print(f"batch={b:4d} impl={IMPL:9s} drop={DROP}: {b/dt:7.1f} samples/s  "
+          f"step {dt*1e3:6.2f}ms  MFU(6ND) {100*mfu(flops, dt, 1, device_peak_flops()):.1f}%",
+          flush=True)

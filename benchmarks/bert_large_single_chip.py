@@ -1,0 +1,78 @@
+"""BERT-large (BASELINE.json configs[3] model) single-chip training step.
+
+configs[3] targets v4-32; this measures the per-chip building block on the
+one local chip — remat trades recompute for HBM so the 340M-param model
+trains at batch sizes a 16G chip could not otherwise hold.
+
+Usage: python benchmarks/bert_large_single_chip.py <batch>[,batch...] [--no-remat]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpudl.data.synthetic import synthetic_token_batches
+from tpudl.models.bert import BERT_LARGE, BertForSequenceClassification
+from tpudl.runtime import MeshSpec, make_mesh, use_hardware_rng
+from tpudl.train import (
+    compile_step,
+    create_train_state,
+    make_classification_train_step,
+)
+from tpudl.train.metrics import device_peak_flops, mfu, transformer_train_flops
+
+use_hardware_rng()
+SEQ = 128
+remat = "--no-remat" not in sys.argv
+batches = [int(x) for x in sys.argv[1].split(",")]
+
+mesh = make_mesh(MeshSpec(dp=-1))
+cfg = BERT_LARGE(remat=remat)
+model = BertForSequenceClassification(cfg)
+state0 = create_train_state(
+    jax.random.key(0),
+    model,
+    jnp.zeros((1, SEQ), jnp.int32),
+    optax.adamw(2e-5, weight_decay=0.01),
+)
+n_params = sum(p.size for p in jax.tree.leaves(state0.params))
+print(f"BERT-large: {n_params / 1e6:.0f}M params, remat={remat}")
+
+for b in batches:
+    state = state0
+    step = compile_step(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"), label_key="label"
+        ),
+        mesh,
+        state,
+        None,
+        donate_state=False,
+    )
+    batch = jax.device_put(
+        next(synthetic_token_batches(b, seq_len=SEQ, vocab_size=30_522))
+    )
+    rng = jax.random.key(1)
+    flops = transformer_train_flops(n_params, b * SEQ)
+    try:
+        for _ in range(10):
+            state, m = step(state, batch, rng)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        N = 20
+        for _ in range(N):
+            state, m = step(state, batch, rng)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / N
+        print(
+            f"batch={b:4d}: {b / dt:7.1f} samples/s  step {dt * 1e3:7.2f}ms  "
+            f"MFU(6ND) {100 * mfu(flops, dt, 1, device_peak_flops()):.1f}%",
+            flush=True,
+        )
+    except Exception as e:
+        print(f"batch={b:4d}: FAILED {type(e).__name__}: {str(e)[:100]}")

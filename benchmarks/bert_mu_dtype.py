@@ -1,0 +1,33 @@
+import pathlib as _pathlib, sys as _sys
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
+import sys, time
+import jax, jax.numpy as jnp, optax
+from tpudl.data.synthetic import synthetic_token_batches
+from tpudl.models.bert import BertConfig, BertForSequenceClassification
+from tpudl.runtime import MeshSpec, make_mesh, use_hardware_rng
+from tpudl.train import compile_step, create_train_state, make_classification_train_step
+use_hardware_rng()
+MU = sys.argv[1]
+if MU not in ("bf16", "f32"):
+    raise SystemExit(f"usage: bert_mu_dtype.py bf16|f32 (got {MU!r})")
+tx = optax.adamw(2e-5, weight_decay=0.01,
+                 mu_dtype=jnp.bfloat16 if MU == "bf16" else None)
+mesh = make_mesh(MeshSpec(dp=-1))
+cfg = BertConfig()
+model = BertForSequenceClassification(cfg)
+state = create_train_state(jax.random.key(0), model,
+                           jnp.zeros((1, 128), jnp.int32), tx)
+step = compile_step(make_classification_train_step(
+    input_keys=("input_ids","attention_mask"), label_key="label"), mesh, state, None)
+batch = jax.device_put(next(synthetic_token_batches(256, seq_len=128, vocab_size=30_522)))
+rng = jax.random.key(1)
+for _ in range(15):
+    state, m = step(state, batch, rng)
+float(m["loss"])
+t0 = time.perf_counter(); N = 30
+for _ in range(N):
+    state, m = step(state, batch, rng)
+float(m["loss"])
+dt = (time.perf_counter()-t0)/N
+print(f"mu={MU}: {256/dt:7.1f} samples/s  step {dt*1e3:6.2f}ms")

@@ -1,0 +1,33 @@
+"""Scratch: flash vs reference attention across sequence lengths (fwd+bwd)."""
+import pathlib as _pathlib, sys as _sys
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
+import sys, time
+import jax, jax.numpy as jnp
+from tpudl.ops.attention import dot_product_attention
+from tpudl.ops.flash_attention import flash_attention
+
+B, H, D = 4, 12, 64
+for S in (int(x) for x in sys.argv[1].split(",")):
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.bfloat16)
+
+    for name, fn in (("reference", dot_product_attention), ("flash", flash_attention)):
+        def loss(q, k, v, fn=fn):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        try:
+            g = step(q, k, v)
+            float(jnp.sum(g[0].astype(jnp.float32))[None][0])
+            t0 = time.perf_counter(); N = 20
+            for _ in range(N):
+                g = step(q, k, v)
+            float(jnp.sum(g[0].astype(jnp.float32))[None][0])
+            dt = (time.perf_counter() - t0) / N
+            # fwd+bwd attention flops ~ 4 * (2*B*H*S^2*D) fwd-equivalent matmuls
+            flops = 4 * 2 * 2 * B * H * S * S * D
+            print(f"S={S:5d} {name:9s}: {dt*1e3:8.2f} ms  {flops/dt/1e12:6.2f} TFLOP/s", flush=True)
+        except Exception as e:
+            print(f"S={S:5d} {name:9s}: FAILED {type(e).__name__}: {str(e)[:120]}", flush=True)

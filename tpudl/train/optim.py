@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import optax
 
 from tpudl.config import OptimConfig
@@ -33,7 +34,11 @@ def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
         )
     else:
         tx = optax.adamw(
-            sched, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay
+            sched,
+            b1=cfg.b1,
+            b2=cfg.b2,
+            weight_decay=cfg.weight_decay,
+            mu_dtype=jnp.dtype(cfg.mu_dtype),
         )
     if cfg.grad_clip_norm:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
