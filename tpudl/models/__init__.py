@@ -9,6 +9,7 @@ from tpudl.models.bert import (  # noqa: F401
     BertModel,
     params_from_hf_bert,
 )
+from tpudl.models.generate import generate  # noqa: F401
 from tpudl.models.llama import (  # noqa: F401
     LLAMA3_8B,
     LLAMA_TINY,

@@ -1,0 +1,117 @@
+"""Autoregressive decoding with a KV cache (Llama serving path).
+
+The reference repo's substance is inference benchmarking of an exported
+model (reference notebooks/cv/onnx_experiments.py:77-140 — build a
+session, run it, time it); this is the decoder-model analog: a jitted
+prefill + a jitted single-token decode step over static-shape KV caches
+(tpudl.models.llama.LlamaAttention decode mode), so the whole generation
+loop runs as two compiled XLA programs regardless of length.
+
+Greedy (temperature=0) or temperature sampling. Prompts must be unpadded
+(cache slot == absolute position keeps the in-cache causal mask a pure
+index comparison); batch prompts of equal length or generate per group.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _prefill(model, params, input_ids, attention_mask):
+    positions = jnp.maximum(
+        jnp.cumsum(attention_mask, axis=-1) - 1, 0
+    ).astype(jnp.int32)
+    logits, mutated = model.apply(
+        {"params": params},
+        input_ids,
+        attention_mask,
+        decode=True,
+        positions=positions,
+        mutable=["cache"],
+    )
+    return logits[:, -1, :], mutated["cache"]
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _decode_step(model, params, cache, token, position):
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache},
+        token[:, None],
+        jnp.ones_like(token)[:, None],
+        decode=True,
+        positions=position[:, None],
+        mutable=["cache"],
+    )
+    return logits[:, -1, :], mutated["cache"]
+
+
+def _select(logits, rng, temperature):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
+
+
+def generate(
+    model,
+    params,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    eos_id: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Generate continuations for a [B, S] prompt batch.
+
+    ``model`` is a LlamaForCausalLM whose config ``max_seq_len`` bounds
+    S + max_new_tokens. Returns [B, max_new_tokens] generated ids (after
+    ``eos_id``, positions are padded with eos).
+    """
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(input_ids)
+    elif not bool(jnp.all(attention_mask == 1)):
+        raise NotImplementedError(
+            "generate() requires unpadded prompts (attention_mask all "
+            "ones): the KV cache indexes by slot == position"
+        )
+    if s + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len {model.cfg.max_seq_len} (the KV cache bound)"
+        )
+    if rng is None:
+        rng = jax.random.key(0)
+
+    logits, cache = _prefill(model, params, input_ids, attention_mask)
+    # Next absolute position per row (mask-aware: left padding skipped).
+    position = jnp.sum(attention_mask, axis=-1).astype(jnp.int32)
+
+    tokens = []
+    done = jnp.zeros((b,), bool)
+    rng, sel_rng = jax.random.split(rng)
+    token = _select(logits, sel_rng, temperature)
+    for i in range(max_new_tokens):
+        if eos_id is not None:
+            token = jnp.where(done, eos_id, token)
+            done = jnp.logical_or(done, token == eos_id)
+        tokens.append(token)
+        if i + 1 == max_new_tokens:
+            break
+        if eos_id is not None and bool(done.all()):
+            # Every row finished: pad the rest with eos, skip dead steps.
+            pad = jnp.full_like(token, eos_id)
+            tokens.extend([pad] * (max_new_tokens - i - 1))
+            break
+        rng, step_rng = jax.random.split(rng)
+        logits, cache = _decode_step(model, params, cache, token, position)
+        position = position + 1
+        token = _select(logits, step_rng, temperature)
+    return jnp.stack(tokens, axis=1)

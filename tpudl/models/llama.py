@@ -128,11 +128,30 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _gqa_decode_attention(q, k, v, mask):
+    """Decode-path attention with query heads grouped over shared KV
+    heads. q: [B, S, H, D]; k, v: [B, T, Hkv, D]; mask: [B, 1, S, T]
+    (True = attend). f32 logits/softmax like
+    tpudl.ops.attention.dot_product_attention."""
+    from tpudl.ops.attention import MASK_VALUE
+
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, s, hkv, g, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * (d ** -0.5)
+    logits = logits.astype(jnp.float32)
+    logits = jnp.where(mask[:, :, None, :, :], logits, MASK_VALUE)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    ctx = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v)
+    return ctx.reshape(b, s, h, d)
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions):
+    def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.cfg
         B, S, _ = hidden.shape
         hd = cfg.head_dim
@@ -144,6 +163,49 @@ class LlamaAttention(nn.Module):
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
+
+        if decode:
+            # KV cache (flax decode idiom): static [B, max_seq, Hkv, D]
+            # buffers updated in place at the current index — the
+            # autoregressive serving path (the reference repo's entire
+            # substance is inference benchmarking; this is its decoder
+            # analog). Shapes stay static so the step jits once.
+            ck = self.variable(
+                "cache", "k",
+                jnp.zeros, (B, cfg.max_seq_len, cfg.num_kv_heads, hd), k.dtype,
+            )
+            cv = self.variable(
+                "cache", "v",
+                jnp.zeros, (B, cfg.max_seq_len, cfg.num_kv_heads, hd), v.dtype,
+            )
+            idx = self.variable(
+                "cache", "index", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, start, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, start, 0, 0)
+            )
+            idx.value = start + S
+            k, v = ck.value, cv.value
+            # Attend only to written positions; within the current chunk,
+            # causal ordering holds (kv_pos <= query position).
+            kv_pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
+            q_pos = positions[:, None, :, None]  # [B, 1, S, 1]
+            mask = kv_pos <= q_pos
+        else:
+            mask = None
+
+        if decode:
+            # Grouped-query attention against the UNEXPANDED cache — never
+            # materialize [B, max_seq, H, D] (the 4x KV blowup per decode
+            # step that GQA exists to avoid).
+            ctx = _gqa_decode_attention(q, k, v, mask)
+            ctx = ctx.reshape(B, S, cfg.num_heads * hd)
+            return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+
         if cfg.num_kv_heads != cfg.num_heads:  # GQA: expand kv heads
             reps = cfg.num_heads // cfg.num_kv_heads
             k = jnp.repeat(k, reps, axis=2)
@@ -153,7 +215,8 @@ class LlamaAttention(nn.Module):
         v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
         ctx = attend(
             q, k, v, causal=True, implementation=cfg.attention_impl
-        ).reshape(B, S, cfg.num_heads * hd)
+        )
+        ctx = ctx.reshape(B, S, cfg.num_heads * hd)
         return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
 
 
@@ -161,10 +224,12 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions):
+    def __call__(self, hidden, positions, decode: bool = False):
         cfg = self.cfg
         attn = LlamaAttention(cfg, name="attention")(
-            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden), positions
+            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden),
+            positions,
+            decode,
         )
         hidden = hidden + attn
         x = RMSNorm(cfg.rms_norm_eps, name="post_attention_norm")(hidden)
@@ -195,14 +260,19 @@ class LlamaModel(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None):
+    def __call__(
+        self, input_ids, attention_mask=None, decode=False, positions=None
+    ):
         cfg = self.cfg
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
-        # Positions skip padding so RoPE phases match left-padded batches.
-        positions = jnp.maximum(
-            jnp.cumsum(attention_mask, axis=-1) - 1, 0
-        ).astype(jnp.int32)
+        if positions is None:
+            # Positions skip padding so RoPE phases match left-padded
+            # batches. Decode callers pass absolute positions explicitly
+            # (tpudl.models.generate tracks the cache offset).
+            positions = jnp.maximum(
+                jnp.cumsum(attention_mask, axis=-1) - 1, 0
+            ).astype(jnp.int32)
         x = nn.Embed(
             cfg.vocab_size,
             cfg.hidden_size,
@@ -211,10 +281,10 @@ class LlamaModel(nn.Module):
         )(input_ids).astype(cfg.dtype)
         x = constrain(x, ("dp", "fsdp"), "sp", "tp")
         block = LlamaBlock
-        if cfg.remat:
-            block = nn.remat(LlamaBlock)
+        if cfg.remat and not decode:
+            block = nn.remat(LlamaBlock, static_argnums=(3,))
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions)
+            x = block(cfg, name=f"layer_{i}")(x, positions, decode)
         return RMSNorm(cfg.rms_norm_eps, name="final_norm")(x)
 
 
@@ -222,8 +292,12 @@ class LlamaForCausalLM(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None):
-        x = LlamaModel(self.cfg, name="model")(input_ids, attention_mask)
+    def __call__(
+        self, input_ids, attention_mask=None, decode=False, positions=None
+    ):
+        x = LlamaModel(self.cfg, name="model")(
+            input_ids, attention_mask, decode, positions
+        )
         logits = nn.Dense(
             self.cfg.vocab_size,
             use_bias=False,
