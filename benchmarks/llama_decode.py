@@ -1,0 +1,64 @@
+"""Llama KV-cache decode throughput (the serving-path analog of the
+reference's inference latency benchmarking — reference
+notebooks/cv/onnx_experiments.py:77-140 times backend inference calls;
+here the backend is the jitted decode step of tpudl.models.generate).
+
+Usage: python benchmarks/llama_decode.py [size] [batch] [new_tokens]
+  size defaults to llama3-1b, batch 8, new_tokens 128.
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.models.generate import _decode_step, _prefill
+from tpudl.models.llama import LLAMA_SIZES, LlamaForCausalLM
+
+size = sys.argv[1] if len(sys.argv) > 1 else "llama3-1b"
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+new_tokens = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+prompt_len = 128
+
+cfg = LLAMA_SIZES[size](max_seq_len=prompt_len + new_tokens + 1)
+model = LlamaForCausalLM(cfg)
+prompt = jax.random.randint(
+    jax.random.key(0), (batch, prompt_len), 0, cfg.vocab_size
+)
+params = model.init(jax.random.key(1), prompt[:1, :8])["params"]
+n_params = sum(p.size for p in jax.tree.leaves(params))
+params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+print(f"{size}: {n_params/1e9:.2f}B params, batch {batch}, "
+      f"prompt {prompt_len}, decode {new_tokens}")
+
+# Prefill timing.
+mask = jnp.ones_like(prompt)
+logits, cache = _prefill(model, params, prompt, mask)  # compile
+float(logits[0, 0])
+t0 = time.perf_counter()
+logits, cache = _prefill(model, params, prompt, mask)
+float(logits[0, 0])
+prefill_s = time.perf_counter() - t0
+
+# Decode-step timing (steady state).
+position = jnp.full((batch,), prompt_len, jnp.int32)
+token = jnp.argmax(logits, -1).astype(jnp.int32)
+logits, cache = _decode_step(model, params, cache, token, position)  # compile
+float(logits[0, 0])
+position = position + 1  # keep position in lockstep with the cache index
+t0 = time.perf_counter()
+for _ in range(new_tokens):
+    logits, cache = _decode_step(model, params, cache, token, position)
+    position = position + 1
+float(logits[0, 0])
+dt = time.perf_counter() - t0
+per_step_ms = dt / new_tokens * 1e3
+print(
+    f"prefill: {prefill_s*1e3:.1f} ms ({batch*prompt_len/prefill_s:,.0f} tok/s)  "
+    f"decode: {per_step_ms:.2f} ms/step, {batch/ (dt/new_tokens):,.0f} tok/s "
+    f"({batch} rows)"
+)

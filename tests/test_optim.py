@@ -1,0 +1,99 @@
+"""Optimizer construction (tpudl.train.optim) from OptimConfig."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudl.config import OptimConfig, get_config
+from tpudl.train.optim import make_optimizer, make_schedule
+
+
+def _adam_mu_leaves(opt_state):
+    """First-moment leaves of an optax adamw state chain."""
+    mus = []
+    for s in jax.tree.leaves(opt_state, is_leaf=lambda x: hasattr(x, "mu")):
+        if hasattr(s, "mu"):
+            mus.extend(jax.tree.leaves(s.mu))
+    return mus
+
+
+def test_mu_dtype_bf16_halves_first_moment():
+    cfg = OptimConfig(name="adamw", mu_dtype="bfloat16")
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.zeros((4, 4), jnp.float32)}
+    state = tx.init(params)
+    mus = _adam_mu_leaves(state)
+    assert mus and all(m.dtype == jnp.bfloat16 for m in mus)
+    # nu (second moment) stays f32 for range.
+    for s in jax.tree.leaves(state, is_leaf=lambda x: hasattr(x, "nu")):
+        if hasattr(s, "nu"):
+            assert all(
+                n.dtype == jnp.float32 for n in jax.tree.leaves(s.nu)
+            )
+
+
+def test_mu_dtype_default_is_f32():
+    tx = make_optimizer(OptimConfig(name="adamw"))
+    state = tx.init({"w": jnp.zeros((2,), jnp.float32)})
+    mus = _adam_mu_leaves(state)
+    assert mus and all(m.dtype == jnp.float32 for m in mus)
+
+
+def test_bert_configs_opt_into_bf16_mu():
+    assert get_config("sst2_bert_base").optim.mu_dtype == "bfloat16"
+    assert get_config("bert_large_v4_32").optim.mu_dtype == "bfloat16"
+
+
+def test_schedule_warmup_then_decay():
+    cfg = OptimConfig(
+        learning_rate=1e-3, warmup_steps=10, total_steps=110, schedule="cosine"
+    )
+    sched = make_schedule(cfg)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1e-3, rtol=1e-6)
+    assert float(sched(100)) < 1e-3
+
+
+def test_optimizer_steps_update_params():
+    tx = make_optimizer(
+        dataclasses.replace(
+            get_config("sst2_bert_base").optim, warmup_steps=0,
+            schedule="constant",
+        )
+    )
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    state = tx.init(params)
+    grads = {"w": jnp.ones((4,), jnp.float32)}
+    updates, state = tx.update(grads, state, params)
+    new_params = jax.tree.map(lambda p, u: p + u, params, updates)
+    assert not np.allclose(np.asarray(new_params["w"]), 1.0)
+
+
+def test_use_hardware_rng_switches_impl():
+    # Run in a subprocess so the global PRNG config doesn't leak into the
+    # rest of the suite.
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "from tpudl.runtime import use_hardware_rng\n"
+        "use_hardware_rng()\n"
+        "k = jax.random.key(0)\n"
+        "impl = str(jax.random.key_impl(k))\n"
+        "assert 'rbg' in impl, impl\n"
+        "print('ok')\n"
+    )
+    import pathlib
+
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=str(pathlib.Path(__file__).resolve().parents[1]),
+        env={**__import__('os').environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0 and "ok" in out.stdout, out.stderr[-500:]

@@ -67,10 +67,21 @@ LLAMA_TINY = partial(
     rope_theta=10_000.0,
 )
 LLAMA3_8B = LlamaConfig
+#: Llama-3.2-1B shape — the largest decoder a single 16G chip serves
+#: comfortably in bf16.
+LLAMA3_1B = partial(
+    LlamaConfig,
+    hidden_size=2048,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    intermediate_size=8192,
+)
 
 #: Size-name registry for tpudl.models.registry.build_llama.
 LLAMA_SIZES = {
     "llama-tiny": LLAMA_TINY,
+    "llama3-1b": LLAMA3_1B,
     "llama3-8b": LLAMA3_8B,
 }
 
