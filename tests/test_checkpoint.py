@@ -132,3 +132,39 @@ def test_manager_restore_without_checkpoint_raises(tmp_path):
     with CheckpointManager(str(tmp_path / "empty")) as mgr:
         with pytest.raises(FileNotFoundError):
             mgr.restore(_fresh_state())
+
+
+def test_fit_periodic_checkpoint_and_resume_latest(tmp_path):
+    """fit(checkpoint_manager=...) saves every N steps + at the end, and
+    resume_latest restores the newest into a fresh state (the one-call
+    cold-start-or-resume site)."""
+    from tpudl.train import fit, resume_latest
+
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step_fn = make_classification_train_step()
+    rng = jax.random.key(0)
+
+    state = _fresh_state()
+    step = compile_step(step_fn, mesh, state, None, donate_state=False)
+    with CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=5) as mgr:
+        state, start = resume_latest(mgr, state)
+        assert start == 0  # cold start: nothing to restore
+        state, _, _ = fit(
+            step,
+            state,
+            _batches(7),
+            rng,
+            checkpoint_manager=mgr,
+            checkpoint_every=3,
+        )
+        # Saved at steps 3, 6 (periodic) and 7 (final).
+        assert mgr.all_steps() == [3, 6, 7]
+
+    # "New process": fresh manager + fresh state, resume from latest.
+    with CheckpointManager(str(tmp_path / "ckpts")) as mgr2:
+        resumed, start = resume_latest(mgr2, _fresh_state(seed=3))
+        assert start == 7 and int(resumed.step) == 7
+        for a, b in zip(
+            jax.tree.leaves(state.params), jax.tree.leaves(resumed.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

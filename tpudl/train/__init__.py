@@ -9,4 +9,5 @@ from tpudl.train.loop import (  # noqa: F401
     fit,
     make_classification_eval_step,
     make_classification_train_step,
+    resume_latest,
 )

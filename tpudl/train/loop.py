@@ -227,6 +227,8 @@ def fit(
     logger: Optional[Callable[[int, dict], None]] = None,
     profile_dir: Optional[str] = None,
     profile_window: tuple = (2, 8),
+    checkpoint_manager=None,
+    checkpoint_every: int = 0,
 ):
     """Drive the compiled step over a batch iterator; returns final state and
     the last metrics (host-synced once at the end, not per step).
@@ -236,6 +238,14 @@ def fit(
     [profile_window[0], profile_window[1]) are captured with
     jax.profiler.trace into a TensorBoard-viewable XLA trace (op-level,
     including ICI collective time), skipping the compile step.
+
+    Checkpointing (SURVEY.md §5.3/§5.4): with a `checkpoint_manager`
+    (tpudl.checkpoint.CheckpointManager) and `checkpoint_every` > 0, the
+    train state is saved every N steps (async — training continues while
+    shards flush) and once at the end. Saves are keyed by the state's own
+    step counter, so a restored-and-continued run lines up with the
+    schedule of an uninterrupted one. Use `resume_latest` to restore
+    before calling fit.
     """
     import os
 
@@ -246,6 +256,12 @@ def fit(
     metrics = None
     start = time.perf_counter()
     n = 0
+    # One host sync up front; the counter advances exactly 1 per compiled
+    # step, so per-step int(state.step) (a device round-trip that would
+    # stall async dispatch) is never needed.
+    start_step = (
+        int(state.step) if checkpoint_manager is not None else 0
+    )
     try:
         for i, batch in enumerate(batches):
             if num_steps is not None and i >= num_steps:
@@ -259,6 +275,10 @@ def fit(
                 jax.profiler.stop_trace()
                 profiling = False
             n += 1
+            if checkpoint_manager is not None and checkpoint_every:
+                step_no = start_step + n
+                if step_no % checkpoint_every == 0:
+                    checkpoint_manager.save(step_no, state)
             if log_every and (i + 1) % log_every == 0:
                 host_metrics = {k: float(v) for k, v in metrics.items()}
                 if logger:
@@ -268,7 +288,38 @@ def fit(
     finally:
         if profiling:
             jax.profiler.stop_trace()
+    if checkpoint_manager is not None and n:
+        step_no = start_step + n
+        if not checkpoint_every or step_no % checkpoint_every != 0:
+            checkpoint_manager.save(step_no, state)
+        checkpoint_manager.wait_until_finished()
     if metrics is not None:
         metrics = {k: float(v) for k, v in metrics.items()}
     elapsed = time.perf_counter() - start
     return state, metrics, {"steps": n, "seconds": elapsed}
+
+
+def resume_latest(
+    checkpoint_manager,
+    state: TrainState,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[Rules] = None,
+) -> tuple:
+    """Restore the latest checkpoint into `state` if one exists.
+
+    Returns ``(state, resumed_step)`` — ``(state, 0)`` untouched when the
+    directory is empty, so cold start and resume are one call site.
+    Fast-forward the data past the consumed steps, or the resumed run
+    re-trains on early batches:
+
+        state, start_step = resume_latest(mgr, state, mesh, rules)
+        fit(step, state, itertools.islice(batches, start_step, None), rng,
+            num_steps=total_steps - start_step, checkpoint_manager=mgr, ...)
+    """
+    latest = checkpoint_manager.latest_step()
+    if latest is None:
+        return state, 0
+    return (
+        checkpoint_manager.restore(state, latest, mesh=mesh, rules=rules),
+        latest,
+    )
