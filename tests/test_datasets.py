@@ -66,3 +66,29 @@ def test_parquet_to_training_smoke(tmp_path, mesh8):
         losses.append(float(metrics["loss"]))
     assert len(losses) == 64  # 2048/64 * 2 epochs
     assert np.mean(losses[-8:]) < np.mean(losses[:8]) * 0.9, losses
+
+
+def test_imagenet_like_pipeline_with_augmenter(tmp_path):
+    """configs[2] data contract at reduced scale: 224x224 uint8 Parquet ->
+    row-group-streamed converter -> native/numpy augmenter -> f32 batches
+    sized for the ResNet-50 input."""
+    from tpudl.data.augment import IMAGENET_MEAN, IMAGENET_STD, BatchAugmenter
+    from tpudl.data.datasets import materialize_imagenet_like
+
+    conv = materialize_imagenet_like(
+        str(tmp_path), num_rows=64, rows_per_file=32, num_classes=10
+    )
+    aug = BatchAugmenter(
+        crop=(224, 224), pad=8, mean=IMAGENET_MEAN, std=IMAGENET_STD, seed=0
+    )
+    it = conv.make_batch_iterator(
+        batch_size=16, shard_index=0, num_shards=1, transform=aug
+    )
+    batch = next(it)
+    assert batch["image"].shape == (16, 224, 224, 3)
+    assert batch["image"].dtype == np.float32
+    assert batch["label"].max() < 10
+    # Two disjoint shards still cover the 224-row schema.
+    a = next(conv.make_batch_iterator(batch_size=8, shard_index=0, num_shards=2))
+    b = next(conv.make_batch_iterator(batch_size=8, shard_index=1, num_shards=2))
+    assert not np.array_equal(a["image"], b["image"])

@@ -1,9 +1,15 @@
-"""L1 data layer: Parquet converter + dataset helpers."""
+"""L1 data layer: Parquet converter, augmentation, dataset helpers."""
 
+from tpudl.data.augment import BatchAugmenter  # noqa: F401
 from tpudl.data.converter import (  # noqa: F401
     Converter,
     make_converter,
     prefetch_to_device,
     write_parquet,
+)
+from tpudl.data.datasets import (  # noqa: F401
+    materialize_cifar10_like,
+    materialize_imagenet_like,
+    materialize_sst2_like,
 )
 from tpudl.data.synthetic import synthetic_classification_batches  # noqa: F401

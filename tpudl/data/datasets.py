@@ -76,6 +76,39 @@ def materialize_sst2_like(
     return make_converter(directory)
 
 
+def materialize_imagenet_like(
+    directory: str,
+    num_rows: int = 512,
+    image_size: int = 224,
+    num_classes: int = 1000,
+    seed: int = 0,
+    rows_per_file: int = 128,
+):
+    """ImageNet-schema Parquet dataset (image uint8 HWC at 224x224, int64
+    label) — the configs[2] data contract at reduced row count. Rows are
+    ~150 KB each, so this also exercises the converter's row-group
+    streaming (tpudl.data.converter reads row group by row group; no whole
+    file ever lives in memory)."""
+    rng = np.random.default_rng(seed)
+    coarse = rng.normal(size=(num_classes, 8, 8, 3)).astype(np.float32)
+    rep = image_size // 8
+    labels = rng.integers(0, num_classes, size=(num_rows,))
+    images = np.empty((num_rows, image_size, image_size, 3), np.uint8)
+    for i in range(num_rows):  # per-row to bound peak memory
+        pattern = np.repeat(np.repeat(coarse[labels[i]], rep, 0), rep, 1)
+        pattern = pattern / max(np.abs(pattern).max(), 1e-6)
+        noise = rng.normal(0.0, 0.15, size=(image_size, image_size, 3))
+        images[i] = (
+            np.clip(0.5 + 0.35 * pattern + noise, 0.0, 1.0) * 255
+        ).astype(np.uint8)
+    write_parquet(
+        directory,
+        {"image": images, "label": labels.astype(np.int64)},
+        rows_per_file=rows_per_file,
+    )
+    return make_converter(directory)
+
+
 def normalize_cifar_batch(batch: dict) -> dict:
     """uint8 HWC -> float32 normalized, keeping other columns."""
     out = dict(batch)
