@@ -49,11 +49,15 @@ def write_parquet(
     directory: str,
     columns: Dict[str, np.ndarray],
     rows_per_file: int = 4096,
+    row_group_size: Optional[int] = None,
 ) -> List[str]:
     """Write a dict of equal-length arrays as a multi-file Parquet dataset.
 
     Multi-dim arrays become FixedSizeList columns with their per-row shape
     stored in field metadata, so readers can restore the tensors.
+    ``row_group_size`` bounds rows per Parquet row group (the converter's
+    streaming granularity — smaller groups cap reader memory on wide
+    rows); default is one group per file.
     """
     if not HAVE_PYARROW:
         raise RuntimeError("pyarrow is required for the Parquet data layer")
@@ -91,7 +95,7 @@ def write_parquet(
     for i, start in enumerate(range(0, n, rows_per_file)):
         chunk = table.slice(start, rows_per_file)
         path = os.path.join(directory, f"part-{i:05d}.parquet")
-        pq.write_table(chunk, path)
+        pq.write_table(chunk, path, row_group_size=row_group_size)
         paths.append(path)
     return paths
 

@@ -17,6 +17,38 @@ import numpy as np
 from tpudl.data.converter import make_converter, write_parquet
 
 
+def _class_pattern_images(
+    rng, labels, image_size: int, block: int, num_classes: int
+) -> np.ndarray:
+    """uint8 [N, image_size, image_size, 3] images carrying a learnable
+    low-frequency per-class signal under noise (the synthetic-signal
+    contract shared by the CIFAR- and ImageNet-schema materializers;
+    same construction as tpudl.data.synthetic). Built in row chunks so
+    peak memory stays bounded at ImageNet sizes."""
+    if image_size % block != 0 or image_size < block:
+        raise ValueError(
+            f"image_size {image_size} must be a positive multiple of the "
+            f"{block}px pattern block"
+        )
+    rep = image_size // block
+    coarse = rng.normal(size=(num_classes, block, block, 3)).astype(np.float32)
+    pattern = np.repeat(np.repeat(coarse, rep, axis=1), rep, axis=2)
+    pattern /= np.abs(pattern).max()
+    n = len(labels)
+    images = np.empty((n, image_size, image_size, 3), np.uint8)
+    chunk = max(1, (1 << 24) // (image_size * image_size * 3 * 4))
+    for lo in range(0, n, chunk):
+        idx = labels[lo : lo + chunk]
+        noise = rng.normal(
+            0.0, 0.15, size=(len(idx), image_size, image_size, 3)
+        ).astype(np.float32)
+        block_imgs = 0.5 + 0.35 * pattern[idx] + noise
+        images[lo : lo + chunk] = (
+            np.clip(block_imgs, 0.0, 1.0) * 255
+        ).astype(np.uint8)
+    return images
+
+
 def materialize_cifar10_like(
     directory: str,
     num_rows: int = 10_000,
@@ -25,19 +57,13 @@ def materialize_cifar10_like(
     rows_per_file: int = 2048,
 ):
     """CIFAR-10-schema Parquet dataset (image uint8 HWC, int64 label) with a
-    learnable low-frequency class signal (same construction as
-    tpudl.data.synthetic)."""
+    learnable low-frequency class signal."""
     rng = np.random.default_rng(seed)
-    coarse = rng.normal(size=(num_classes, 4, 4, 3)).astype(np.float32)
-    pattern = np.repeat(np.repeat(coarse, 8, axis=1), 8, axis=2)
-    pattern /= np.abs(pattern).max()
     labels = rng.integers(0, num_classes, size=(num_rows,))
-    noise = rng.normal(0.0, 0.15, size=(num_rows, 32, 32, 3)).astype(np.float32)
-    images = 0.5 + 0.35 * pattern[labels] + noise
-    images_u8 = (np.clip(images, 0.0, 1.0) * 255).astype(np.uint8)
+    images = _class_pattern_images(rng, labels, 32, 4, num_classes)
     write_parquet(
         directory,
-        {"image": images_u8, "label": labels.astype(np.int64)},
+        {"image": images, "label": labels.astype(np.int64)},
         rows_per_file=rows_per_file,
     )
     return make_converter(directory)
@@ -83,28 +109,22 @@ def materialize_imagenet_like(
     num_classes: int = 1000,
     seed: int = 0,
     rows_per_file: int = 128,
+    row_group_size: int = 32,
 ):
     """ImageNet-schema Parquet dataset (image uint8 HWC at 224x224, int64
-    label) — the configs[2] data contract at reduced row count. Rows are
-    ~150 KB each, so this also exercises the converter's row-group
-    streaming (tpudl.data.converter reads row group by row group; no whole
-    file ever lives in memory)."""
+    label) — the configs[2] data contract at reduced row count.
+    ``image_size`` must be a multiple of 8 (the class-pattern block).
+    Files are written with small row groups (~150 KB rows x 32), so the
+    converter's row-group streaming is genuinely exercised: readers hold
+    one group, never a whole file."""
     rng = np.random.default_rng(seed)
-    coarse = rng.normal(size=(num_classes, 8, 8, 3)).astype(np.float32)
-    rep = image_size // 8
     labels = rng.integers(0, num_classes, size=(num_rows,))
-    images = np.empty((num_rows, image_size, image_size, 3), np.uint8)
-    for i in range(num_rows):  # per-row to bound peak memory
-        pattern = np.repeat(np.repeat(coarse[labels[i]], rep, 0), rep, 1)
-        pattern = pattern / max(np.abs(pattern).max(), 1e-6)
-        noise = rng.normal(0.0, 0.15, size=(image_size, image_size, 3))
-        images[i] = (
-            np.clip(0.5 + 0.35 * pattern + noise, 0.0, 1.0) * 255
-        ).astype(np.uint8)
+    images = _class_pattern_images(rng, labels, image_size, 8, num_classes)
     write_parquet(
         directory,
         {"image": images, "label": labels.astype(np.int64)},
         rows_per_file=rows_per_file,
+        row_group_size=row_group_size,
     )
     return make_converter(directory)
 
