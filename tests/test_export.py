@@ -118,3 +118,36 @@ def test_resnet_export_load_parity(tmp_path, rng_np):
         rtol=1e-5,
         atol=1e-4,  # the reference's parity contract
     )
+
+
+def test_bert_export_load_parity(tmp_path, rng_np):
+    """The NLP family through the same export -> load -> parity guard
+    (the deployment artifact for configs[1]/[3] fine-tuned classifiers)."""
+    from tpudl.models.bert import BERT_TINY, BertForSequenceClassification
+
+    cfg = BERT_TINY(
+        num_labels=2, dtype=jnp.float32,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = BertForSequenceClassification(cfg)
+    ids = jnp.asarray(
+        rng_np.integers(0, cfg.vocab_size, size=(2, 16)), jnp.int32
+    )
+    mask = jnp.ones_like(ids)
+    params = model.init(jax.random.key(0), ids, train=False)["params"]
+
+    def forward(params, input_ids, attention_mask):
+        return model.apply(
+            {"params": params}, input_ids, attention_mask, train=False
+        )
+
+    args = (params, ids, mask)
+    path = str(tmp_path / "bert.stablehlo")
+    export_stablehlo(forward, args, path=path)
+    restored = load_exported(path)
+    np.testing.assert_allclose(
+        np.asarray(restored(*args)),
+        np.asarray(forward(*args)),
+        rtol=1e-5,
+        atol=1e-4,  # the reference's parity contract
+    )
