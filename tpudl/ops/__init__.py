@@ -2,7 +2,10 @@
 
 - attention.py        — reference einsum attention (+ masks, dropout);
 - flash_attention.py  — Pallas fused online-softmax kernel, fwd + bwd;
-- ring_attention.py   — sequence-parallel ring attention over `sp`;
+- ring_attention.py   — sequence-parallel ring attention over `sp`
+                        (ppermute K/V rotation, online-softmax merge);
+- ulysses.py          — sequence-parallel attention over `sp` via
+                        all-to-all head/seq resharding (exact numerics);
 - moe.py              — top-k routed expert FFN over `ep` (all-to-all).
 """
 

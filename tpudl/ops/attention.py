@@ -67,6 +67,50 @@ def padding_mask(attention_mask: jax.Array) -> jax.Array:
     return attention_mask[:, None, None, :].astype(bool)
 
 
+def normalize_kv_mask(
+    mask: Optional[jax.Array],
+    batch: int,
+    kv_len: int,
+    dtype=jnp.int32,
+    impl: str = "attention",
+) -> jax.Array:
+    """The kv-validity-mask contract shared by the flash/ring/ulysses
+    implementations: None -> all-ones; [B, 1, 1, S] padding masks squeeze
+    to [B, S]; dense [B, H, Sq, Skv] masks are rejected (only the
+    reference implementation supports those)."""
+    if mask is None:
+        return jnp.ones((batch, kv_len), dtype)
+    if mask.ndim == 4:
+        if mask.shape[1] != 1 or mask.shape[2] != 1:
+            raise NotImplementedError(
+                f"{impl} supports [B, S] / [B, 1, 1, S] padding masks and "
+                f"causal=True; got dense mask {mask.shape} — use "
+                f"implementation='reference'"
+            )
+        mask = mask[:, 0, 0, :]
+    return jnp.broadcast_to(mask, (batch, kv_len)).astype(dtype)
+
+
+def unmeshed_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array],
+    causal: bool,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-device degenerate path for the sequence-parallel
+    implementations: reference attention with the kv-validity mask and the
+    causal triangle correctly COMBINED (a causal model with padded batches
+    must not see future positions just because a padding mask is set)."""
+    kvm = normalize_kv_mask(mask, q.shape[0], k.shape[1]) if mask is not None else None
+    full = padding_mask(kvm) if kvm is not None else None
+    if causal:
+        tri = causal_mask(q.shape[1], k.shape[1])
+        full = tri if full is None else jnp.logical_and(full, tri)
+    return dot_product_attention(q, k, v, full, scale=scale)
+
+
 def attend(
     q: jax.Array,
     k: jax.Array,
@@ -83,11 +127,16 @@ def attend(
     implementation:
       "reference" — this module's einsum attention (any backend);
       "flash"     — Pallas TPU flash-attention kernel;
-      "ring"      — sequence-parallel ring attention over the `sp` mesh axis.
+      "ring"      — sequence-parallel ring attention over the `sp` mesh
+                    axis (ppermute K/V rotation, online-softmax merge);
+      "ulysses"   — sequence-parallel attention via all-to-all head/seq
+                    resharding over `sp` (exact reference numerics;
+                    requires local heads divisible by sp).
 
     Attention-probability dropout is only supported by the reference
-    implementation; flash/ring reject a nonzero rate rather than silently
-    dropping it (fine-tune with attention_dropout=0 on those paths).
+    implementation; flash/ring/ulysses reject a nonzero rate rather than
+    silently dropping it (fine-tune with attention_dropout=0 on those
+    paths).
     """
     if dropout_rate > 0.0 and dropout_rng is None:
         raise ValueError(
@@ -113,4 +162,8 @@ def attend(
         from tpudl.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, mask=mask, causal=causal)
+    if implementation == "ulysses":
+        from tpudl.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, mask=mask, causal=causal)
     raise ValueError(f"unknown attention implementation: {implementation!r}")

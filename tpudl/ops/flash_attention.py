@@ -439,19 +439,12 @@ def flash_attention(
     if interpret is None:
         interpret = _interpret_default()
 
+    from tpudl.ops.attention import normalize_kv_mask
+
     has_mask = mask is not None
-    if mask is None:
-        kvmask = jnp.ones((b, skv), jnp.float32)
-    else:
-        if mask.ndim == 4:
-            if mask.shape[1] != 1 or mask.shape[2] != 1:
-                raise NotImplementedError(
-                    "flash_attention supports padding masks [B, 1, 1, Skv] or "
-                    "[B, Skv] and in-kernel causal masking; got dense mask "
-                    f"shape {mask.shape} — use implementation='reference'"
-                )
-            mask = mask[:, 0, 0, :]
-        kvmask = jnp.broadcast_to(mask, (b, skv)).astype(jnp.float32)
+    kvmask = normalize_kv_mask(
+        mask, b, skv, dtype=jnp.float32, impl="flash_attention"
+    )
 
     return _flash(
         q, k, v, kvmask, causal, scale, block_q, block_k, interpret, has_mask

@@ -100,7 +100,7 @@ def ring_attention(
     (tpudl.parallel.sharding.active_mesh); batch shards over (dp, fsdp),
     sequence over `sp`, heads over `tp`.
     """
-    from tpudl.ops.attention import causal_mask, dot_product_attention
+    from tpudl.ops.attention import normalize_kv_mask, unmeshed_attention
     from tpudl.parallel.sharding import current_mesh
 
     if mesh is None:
@@ -109,9 +109,7 @@ def ring_attention(
         # No mesh (single-device init/eval): ring degenerates to reference
         # attention — numerically identical, so models with
         # attention_impl="ring" init and evaluate unmeshed.
-        if mask is None and causal:
-            mask = causal_mask(q.shape[1], k.shape[1])
-        return dot_product_attention(q, k, v, mask, scale=scale)
+        return unmeshed_attention(q, k, v, mask, causal, scale)
     b, s, h, d = q.shape
     if k.shape[1] != s:
         raise ValueError(
@@ -124,17 +122,7 @@ def ring_attention(
     if scale is None:
         scale = d ** -0.5
 
-    if mask is None:
-        kvm = jnp.ones((b, s), jnp.int32)
-    else:
-        if mask.ndim == 4:
-            if mask.shape[1] != 1 or mask.shape[2] != 1:
-                raise NotImplementedError(
-                    "ring_attention supports [B, S] / [B, 1, 1, S] padding "
-                    f"masks and causal=True; got dense mask {mask.shape}"
-                )
-            mask = mask[:, 0, 0, :]
-        kvm = jnp.broadcast_to(mask, (b, s)).astype(jnp.int32)
+    kvm = normalize_kv_mask(mask, b, s, impl="ring_attention")
 
     batch = tuple(a for a in BATCH_AXES if mesh.shape[a] > 1) or None
     heads = AXIS_TENSOR if h % max(mesh.shape[AXIS_TENSOR], 1) == 0 else None

@@ -1,0 +1,128 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+The second of the two canonical long-context strategies (the brief's
+"ring attention OR all-to-all sequence parallelism"; the reference tree
+has neither — its NLP family is an empty placeholder, reference
+notebooks/nlp/README.md, SURVEY.md §5.7). Complements
+tpudl.ops.ring_attention:
+
+- **ring**: K/V shards rotate around the `sp` ring (n-1 ppermute hops
+  overlapped with blockwise compute); attention math is reimplemented as
+  an online-softmax merge. Communication scales with S but overlaps.
+- **ulysses** (this module): two `all_to_all` collectives reshard
+  activations from sequence-sharded [B, S/n, H, D] to head-sharded
+  [B, S, H/n, D]; in between, every device runs UNMODIFIED full-sequence
+  attention on its head slice. Exact same numerics as the reference
+  implementation by construction, any mask kind works locally, and the
+  all-to-all rides ICI's all-to-all bandwidth — but requires
+  heads % sp == 0, and peak activation memory holds the full sequence
+  for H/n heads.
+
+Which to use: ulysses while heads ≥ sp (cheap, exact, simple); ring when
+sequence length pushes past what a full-S slice of heads can hold or
+sp exceeds the head count.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpudl.runtime.mesh import AXIS_SEQ, BATCH_AXES, AXIS_TENSOR
+
+
+def _ulysses_local(q, k, v, kvm, *, axis_name, causal, scale):
+    """Per-device body. q/k/v: [B, S/n, H_local, D] (H_local = H/tp·... the
+    heads remaining on this device's tp slice); kvm: [B, S] full-sequence
+    kv-validity row (replicated over sp)."""
+    from tpudl.ops.attention import causal_mask, dot_product_attention
+
+    n = jax.lax.psum(1, axis_name)
+
+    # [B, S/n, H, D] -> [B, S, H/n, D]: split heads over the ring, gather
+    # the sequence. One ICI all-to-all each way.
+    def seq_to_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    if n > 1:
+        q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+
+    mask = (kvm > 0)[:, None, None, :]
+    if causal:
+        mask = jnp.logical_and(mask, causal_mask(q.shape[1], k.shape[1]))
+    out = dot_product_attention(q, k, v, mask=mask, scale=scale)
+    if n > 1:
+        out = heads_to_seq(out)
+    return out
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = AXIS_SEQ,
+) -> jax.Array:
+    """Sequence-parallel attention on [B, S, H, D] via all-to-all
+    (tpudl.ops.attention contract; Sq == Skv — one shared sequence axis).
+
+    ``mask`` may be a [B, S] kv-validity row or a [B, 1, 1, S] padding
+    mask (dense masks are rejected, as in ring/flash). ``mesh`` defaults
+    to the active tpudl mesh; batch shards over (dp, fsdp), sequence over
+    `sp`, heads over `tp` — requires (H / tp) % sp == 0.
+    """
+    from tpudl.ops.attention import normalize_kv_mask, unmeshed_attention
+    from tpudl.parallel.sharding import current_mesh
+
+    if mesh is None:
+        mesh = current_mesh()
+    if mesh is None:
+        return unmeshed_attention(q, k, v, mask, causal, scale)
+
+    b, s, h, d = q.shape
+    if k.shape[1] != s:
+        raise ValueError(
+            f"ulysses attention shards q and kv along one sequence axis; "
+            f"got Sq={s}, Skv={k.shape[1]}"
+        )
+    n_sp = mesh.shape[axis_name]
+    n_tp = mesh.shape[AXIS_TENSOR]
+    if s % n_sp != 0:
+        raise ValueError(f"seq len {s} not divisible by {axis_name}={n_sp}")
+    local_heads = h // n_tp if h % n_tp == 0 else h
+    if local_heads % n_sp != 0:
+        raise ValueError(
+            f"{local_heads} local heads not divisible by {axis_name}={n_sp} "
+            f"(ulysses shards heads over sp; use implementation='ring' when "
+            f"sp exceeds the per-device head count)"
+        )
+    if scale is None:
+        scale = d ** -0.5
+
+    kvm = normalize_kv_mask(mask, b, s, impl="ulysses_attention")
+
+    batch = tuple(a for a in BATCH_AXES if mesh.shape[a] > 1) or None
+    heads = AXIS_TENSOR if h % max(n_tp, 1) == 0 and n_tp > 1 else None
+    qkv_spec = P(batch, axis_name, heads, None)
+    fn = jax.shard_map(
+        partial(_ulysses_local, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch, None)),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kvm)

@@ -13,7 +13,8 @@ framework supports. Unused axes have size 1 and cost nothing:
 - ``fsdp`` — data parallelism with parameter/optimizer sharding
              (ZeRO-3 / GSPMD-style; params all-gathered per layer by XLA).
 - ``sp``   — sequence/context parallelism (activations sharded along the
-             sequence axis; ring attention moves K/V blocks via ppermute).
+             sequence axis; ring attention rotates K/V via ppermute, or
+             ulysses attention reshards heads<->sequence via all-to-all).
 - ``tp``   — tensor (model) parallelism (contracting-dim sharding of
              matmuls; XLA inserts all-reduce/reduce-scatter).
 - ``pp``   — pipeline parallelism (layer stages spread over devices;
