@@ -41,8 +41,10 @@ RESNET_BATCH = 256
 RESNET_WARMUP_STEPS = 25
 RESNET_MEASURE_STEPS = 50
 RESNET50_BATCH = 128
-RESNET50_WARMUP_STEPS = 8
-RESNET50_MEASURE_STEPS = 16
+RESNET50_WARMUP_STEPS = 10
+# ~50 ms/step: 48 steps give a ~2.4 s window (16 measured 10% run-to-run
+# noise through the relay).
+RESNET50_MEASURE_STEPS = 48
 # Batch 256 keeps the MXU fed: 32 -> 256 raised measured MFU 34% -> 49%
 # (sweep 2026-07-30); dropout stays at the standard fine-tune 0.1.
 BERT_BATCH = 256
