@@ -7,16 +7,20 @@ A ground-up JAX/XLA/pjit/Pallas re-design of the capability surface of
   launcher (replaces HorovodRunner / pyspark TorchDistributor; the reference
   has no launcher in-tree, see SURVEY.md §2.3).
 - ``tpudl.data``     — Petastorm-style Parquet converter feeding per-host
-  sharded batches to JAX.
-- ``tpudl.models``   — Flax model families (CV: ResNet; NLP: BERT et al.),
-  replacing the reference's torchvision ResNet-50 usage
+  sharded batches to JAX; batch augmentation backed by the native C++
+  kernels in ``tpudl/native``.
+- ``tpudl.models``   — Flax model families (CV: ResNet; NLP: BERT, Llama
+  with LoRA/MoE and KV-cache generation), replacing the reference's
+  torchvision ResNet-50 usage
   (reference: notebooks/cv/onnx_experiments.py:19) and the declared-but-empty
   NLP family (reference: notebooks/nlp/README.md).
-- ``tpudl.ops``      — TPU kernels: fused/flash attention (Pallas), ring
-  attention for sequence/context parallelism.
-- ``tpudl.parallel`` — sharding rules (DP / FSDP / TP / SP) over a named mesh;
-  XLA collectives over ICI replace the lineage's NCCL allreduce.
-- ``tpudl.train``    — Optax train loops, metrics (images/sec/chip, MFU).
+- ``tpudl.ops``      — TPU kernels: fused/flash attention (Pallas), ring and
+  ulysses sequence/context parallelism, expert-parallel MoE routing.
+- ``tpudl.parallel`` — sharding rules (DP / FSDP / TP / SP / EP) over a named
+  6-axis mesh plus the GPipe pipeline schedule (PP); XLA collectives over
+  ICI replace the lineage's NCCL allreduce.
+- ``tpudl.train``    — Optax train loops, metrics (images/sec/chip, MFU),
+  periodic async checkpointing with resume.
 - ``tpudl.export``   — StableHLO export, cross-backend numerical parity and
   latency benchmarking — the reference's signature behavior
   (reference: notebooks/cv/onnx_experiments.py:81-144) rebuilt as a
