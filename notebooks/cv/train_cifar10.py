@@ -58,9 +58,10 @@ def main():
         make_classification_train_step(cfg.label_smoothing), mesh, state, None
     )
 
+    warmup_steps = 2
     if args.data_dir:
         from tpudl.data.augment import BatchAugmenter
-        from tpudl.data.converter import make_converter, prefetch_to_device
+        from tpudl.data.converter import make_converter
         from tpudl.data.datasets import materialize_cifar10_like
 
         if args.materialize:
@@ -77,28 +78,40 @@ def main():
             batch_size, epochs=None, shuffle=True, seed=cfg.seed,
             transform=augment,
         )
-        batches = prefetch_to_device(raw, mesh=mesh)
     else:
-        batches = synthetic_classification_batches(
+        raw = synthetic_classification_batches(
             batch_size,
             image_shape=(cfg.image_size, cfg.image_size, 3),
             num_classes=cfg.num_classes,
             seed=cfg.seed,
-            num_batches=args.steps,
+            num_batches=args.steps + warmup_steps,
         )
+    # Prefetch either stream: explicit placement overlaps the host->device
+    # transfer with compute (jit's implicit numpy-arg transfer is
+    # pathologically slow on relay-attached devices).
+    from tpudl.data.converter import prefetch_to_device
+
+    batches = prefetch_to_device(raw, mesh=mesh)
     rng = jax.random.key(cfg.seed + 1)
 
     def log(i, metrics):
         print(f"step {i}: loss {metrics['loss']:.4f} acc {metrics['accuracy']:.3f}")
 
+    # Warmup outside the timing window, closed by a readback (compile is
+    # synchronous, but program upload + first execution on the relay-
+    # attached chip is async behind the dispatch).
+    batches = iter(batches)
+    for _ in range(warmup_steps):
+        state, warm = step(state, next(batches), rng)
+    float(warm["loss"])
     state, metrics, info = fit(
-        step, state, batches, rng, num_steps=args.steps, log_every=cfg.log_every,
-        logger=log,
+        step, state, batches, rng, num_steps=args.steps,
+        log_every=cfg.log_every, logger=log,
     )
     print(f"final: {metrics}")
     print(
         f"throughput ~{batch_size * info['steps'] / info['seconds']:.0f} images/sec "
-        f"over {info['steps']} steps (includes compile)"
+        f"over {info['steps']} steady-state steps (compile + warmup excluded)"
     )
 
 

@@ -207,9 +207,29 @@ def compile_step(
             donate_argnums=(0,) if donate_state else (),
         )
 
-    def wrapped(*args):
+    def _placed(tree, shardings):
+        # Explicit placement before the call, for two measured reasons:
+        # - jit's implicit numpy-arg transfer is pathologically slow on
+        #   relay-attached devices (2.9 s/step vs 1 ms explicit put);
+        # - an uncommitted first argument compiles a second executable the
+        #   moment the (committed) outputs are fed back in — a silent
+        #   duplicate compile (~60 s for BERT-base) inside the first
+        #   training step.
+        # Committed args pass through untouched, so the steady state is a
+        # no-op scan over the leaves.
+        leaves = jax.tree.leaves(tree)
+        if all(
+            isinstance(leaf, jax.Array) and leaf.committed
+            for leaf in leaves
+        ):
+            return tree
+        return jax.device_put(tree, shardings)
+
+    def wrapped(state_arg, batch, *rest):
+        state_arg = _placed(state_arg, state_sh)
+        batch = _placed(batch, batch_sh)
         with active_mesh(mesh):
-            return jitted(*args)
+            return jitted(state_arg, batch, *rest)
 
     wrapped.jitted = jitted  # expose for lower()/cost analysis
     wrapped.state_shardings = state_sh
