@@ -3,8 +3,10 @@ workload asserting loss decreases — on a tiny model so the CPU backend stays
 fast, and on a real 8-fake-device mesh so the pjit path is exercised."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from tpudl.data.synthetic import synthetic_classification_batches
 from tpudl.models.resnet import ResNetTiny
@@ -71,3 +73,30 @@ def test_eval_step_runs(mesh8):
     )
     metrics = eval_step(state, batch)
     assert 0.0 <= float(metrics["accuracy"]) <= 1.0
+
+
+def test_evaluate_weighted_mean():
+    """evaluate() aggregates example-weighted means over the dataset."""
+    from tpudl.train.loop import evaluate
+
+    state = _make_state()
+    mesh = make_mesh(MeshSpec(dp=-1))
+    eval_step = compile_step(
+        make_classification_eval_step(), mesh, state, None, has_rng=False
+    )
+    batches = list(
+        synthetic_classification_batches(
+            8, image_shape=(16, 16, 3), num_classes=4, num_batches=3
+        )
+    )
+    out = evaluate(eval_step, state, batches)
+    assert set(out) == {"loss", "accuracy"}
+    assert np.isfinite(out["loss"]) and 0.0 <= out["accuracy"] <= 1.0
+    # Weighted mean equals per-batch mean when batches are equal-sized.
+    per_batch = [eval_step(state, b) for b in batches]
+    expected = float(np.mean([float(m["loss"]) for m in per_batch]))
+    np.testing.assert_allclose(out["loss"], expected, rtol=1e-6)
+    with pytest.raises(ValueError, match="no batches"):
+        evaluate(eval_step, state, [])
+    with pytest.raises(ValueError, match="positive"):
+        evaluate(eval_step, state, batches, num_steps=0)

@@ -6,6 +6,7 @@ from tpudl.train.loop import (  # noqa: F401
     compile_step,
     create_train_state,
     cross_entropy_loss,
+    evaluate,
     fit,
     make_classification_eval_step,
     make_classification_train_step,

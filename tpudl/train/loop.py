@@ -319,6 +319,38 @@ def fit(
     return state, metrics, {"steps": n, "seconds": elapsed}
 
 
+def evaluate(
+    compiled_eval_step: Callable,
+    state: TrainState,
+    batches: Iterable[dict],
+    num_steps: Optional[int] = None,
+) -> dict:
+    """Drive a compiled eval step (``compile_step(..., has_rng=False)``)
+    over a dataset and return example-weighted mean metrics.
+
+    Metrics are weighted by each batch's leading dim, so a non-dropped
+    smaller last batch is averaged correctly — note that on a sharded
+    mesh its size must still divide the (dp, fsdp) batch axes, and every
+    distinct batch size compiles its own executable (pad or drop_last
+    when that matters). One host sync at the end.
+    """
+    if num_steps is not None and num_steps <= 0:
+        raise ValueError(f"num_steps must be positive, got {num_steps}")
+    totals: dict = {}
+    n_examples = 0
+    for i, batch in enumerate(batches):
+        if num_steps is not None and i >= num_steps:
+            break
+        metrics = compiled_eval_step(state, batch)
+        bs = next(iter(batch.values())).shape[0]
+        n_examples += bs
+        for k, v in metrics.items():
+            totals[k] = totals.get(k, 0.0) + v * bs
+    if n_examples == 0:
+        raise ValueError("evaluate() received no batches")
+    return {k: float(v) / n_examples for k, v in totals.items()}
+
+
 def resume_latest(
     checkpoint_manager,
     state: TrainState,
