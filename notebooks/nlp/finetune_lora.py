@@ -14,6 +14,7 @@ Run: python notebooks/nlp/finetune_lora.py [--steps N] [--model llama-tiny-lora]
 """
 
 import argparse
+import itertools
 import pathlib
 import sys
 
@@ -83,20 +84,27 @@ def main():
         rules,
     )
 
+    warmup = min(2, args.steps)
     batches = synthetic_token_batches(
         args.batch,
         seq_len=args.seq_len,
         vocab_size=model.cfg.vocab_size,
         num_classes=cfg.num_classes,
         seed=cfg.seed,
-        num_batches=args.steps,
+        num_batches=args.steps + warmup,
     )
     logger = MetricLogger(args.log_dir) if args.log_dir else None
+    rng = jax.random.key(cfg.seed + 1)
+    # Warmup fit absorbs compile so the throughput print is steady-state
+    # (the repo-wide timing doctrine — bench.py). islice hands fit exactly
+    # `warmup` items: fit's own num_steps break would pull (and discard)
+    # one extra batch from the shared generator.
+    state, _, _ = fit(step, state, itertools.islice(batches, warmup), rng)
     state, metrics, info = fit(
         step,
         state,
         batches,
-        jax.random.key(cfg.seed + 1),
+        rng,
         num_steps=args.steps,
         log_every=20,
         logger=logger,
@@ -105,8 +113,8 @@ def main():
         logger.close()
     print(f"final: {metrics}")
     print(f"{args.batch * info['steps'] / info['seconds']:.1f} samples/sec "
-          f"over {info['steps']} steps (includes compile) on mesh "
-          f"{dict(mesh.shape)}")
+          f"over {info['steps']} steady-state steps (compile excluded) on "
+          f"mesh {dict(mesh.shape)}")
 
 
 if __name__ == "__main__":

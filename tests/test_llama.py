@@ -95,6 +95,35 @@ def test_loss_decreases_classification():
     assert float(metrics["loss"]) < first * 0.7
 
 
+def test_left_and_right_padding_equivalent(rng_np):
+    """The kv-validity mask must hide pad positions from attention: a
+    LEFT-padded batch produces the same real-token logits as its
+    right-padded equivalent (positions already skip padding for RoPE;
+    without the mask, left pads would be attended as garbage context)."""
+    model = LlamaForCausalLM(TINY)
+    b, seq, real = 2, 12, 7
+    content = rng_np.integers(5, 500, size=(b, real)).astype(np.int32)
+    pad = np.zeros((b, seq - real), np.int32)
+    right_ids = jnp.asarray(np.concatenate([content, pad], axis=1))
+    left_ids = jnp.asarray(np.concatenate([pad, content], axis=1))
+    right_mask = jnp.asarray(
+        np.concatenate([np.ones((b, real)), np.zeros((b, seq - real))], 1)
+    ).astype(jnp.int32)
+    left_mask = jnp.asarray(
+        np.concatenate([np.zeros((b, seq - real)), np.ones((b, real))], 1)
+    ).astype(jnp.int32)
+
+    variables = model.init(jax.random.key(0), right_ids, right_mask)
+    out_r = model.apply(variables, right_ids, right_mask)
+    out_l = model.apply(variables, left_ids, left_mask)
+    np.testing.assert_allclose(
+        np.asarray(out_l[:, seq - real:]),
+        np.asarray(out_r[:, :real]),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
 def test_registry_builds_llama_with_lora():
     model = build_llama("llama-tiny-lora", num_classes=2, dtype=jnp.float32)
     assert model.cfg.lora_rank == 16

@@ -54,6 +54,24 @@ def test_route_topk_capacity_drops_tokens():
     np.testing.assert_allclose(np.asarray(per_token[3:]), 0.0, atol=1e-6)
 
 
+def test_route_topk_dropped_choice_shrinks_combine_weight():
+    """GShard normalization: a capacity-dropped choice's gate mass reduces
+    the surviving choices' combine weight — it is NOT renormalized onto
+    the survivor (the dropped mass rides the residual connection)."""
+    probs = jnp.asarray(
+        [[[0.6, 0.3, 0.05, 0.05],   # token 0: top-2 = experts 0, 1
+          [0.6, 0.05, 0.3, 0.05]]]  # token 1: top-2 = experts 0, 2
+    )
+    # capacity=1: expert 0 keeps only token 0; token 1's expert-0 mass
+    # drops, its second choice (expert 2, uncontended) survives.
+    _, comb, _ = route_topk(probs, k=2, capacity=1)
+    per_token = jnp.sum(comb, axis=(2, 3))[0]
+    np.testing.assert_allclose(float(per_token[0]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(
+        float(per_token[1]), 0.3 / (0.6 + 0.3), atol=1e-6
+    )
+
+
 def test_route_topk_aux_loss_uniform_router():
     probs = jnp.full((B, S, E), 1.0 / E)
     _, _, aux = route_topk(probs, k=1, capacity=S)

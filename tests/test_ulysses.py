@@ -1,9 +1,11 @@
 """Ulysses (all-to-all) sequence parallelism on the fake 8-device mesh.
 
 Parity discipline as tests/test_ring_attention.py: sp>1 mesh from fake
-CPU devices, outputs vs the reference einsum attention. Ulysses runs the
-reference math verbatim on resharded activations, so parity is exact at
-f32 (not merely within online-softmax tolerance).
+CPU devices, outputs vs the reference einsum attention. With
+local_impl="reference" (pinned in the exact-parity tests; also the CPU
+default) ulysses runs the reference math verbatim on resharded
+activations, so parity is exact at f32 — the flash local body gets its
+own tolerance-based test.
 """
 
 import jax
@@ -37,7 +39,7 @@ def _padding(seed, b, s):
 def test_ulysses_matches_reference_no_mask(sp_mesh):
     q, k, v = _qkv(0)
     expected = attend(q, k, v)
-    got = ulysses_attention(q, k, v, mesh=sp_mesh)
+    got = ulysses_attention(q, k, v, mesh=sp_mesh, local_impl="reference")
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expected), atol=2e-5
     )
@@ -47,7 +49,9 @@ def test_ulysses_padding_mask(sp_mesh):
     q, k, v = _qkv(1)
     am = _padding(2, 4, 64)
     expected = attend(q, k, v, mask=padding_mask(am))
-    got = ulysses_attention(q, k, v, mask=padding_mask(am), mesh=sp_mesh)
+    got = ulysses_attention(
+        q, k, v, mask=padding_mask(am), mesh=sp_mesh, local_impl="reference"
+    )
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expected), atol=2e-5
     )
@@ -56,7 +60,9 @@ def test_ulysses_padding_mask(sp_mesh):
 def test_ulysses_causal(sp_mesh):
     q, k, v = _qkv(3)
     expected = attend(q, k, v, mask=causal_mask(64, 64))
-    got = ulysses_attention(q, k, v, causal=True, mesh=sp_mesh)
+    got = ulysses_attention(
+        q, k, v, causal=True, mesh=sp_mesh, local_impl="reference"
+    )
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expected), atol=2e-5
     )
@@ -69,7 +75,10 @@ def test_ulysses_grads_match(sp_mesh):
         return jnp.sum(attend(q, k, v) ** 2)
 
     def loss_uly(q, k, v):
-        return jnp.sum(ulysses_attention(q, k, v, mesh=sp_mesh) ** 2)
+        return jnp.sum(
+            ulysses_attention(q, k, v, mesh=sp_mesh, local_impl="reference")
+            ** 2
+        )
 
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     g_uly = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
@@ -90,7 +99,7 @@ def test_ulysses_composes_with_tp(sp_mesh):
     """sp=2 x tp=2: heads split over tp, remaining heads over sp."""
     mesh = make_mesh(MeshSpec(dp=2, fsdp=1, sp=2, tp=2))
     q, k, v = _qkv(6, h=4)
-    got = ulysses_attention(q, k, v, mesh=mesh)
+    got = ulysses_attention(q, k, v, mesh=mesh, local_impl="reference")
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(attend(q, k, v)), atol=2e-5
     )
@@ -126,6 +135,24 @@ def test_unmeshed_fallback_combines_causal_and_padding():
         np.testing.assert_allclose(
             np.asarray(got_ring), np.asarray(expected), atol=2e-5
         )
+
+
+def test_ulysses_flash_local_impl(sp_mesh):
+    """local_impl='flash' (the TPU long-context default — no [B, H/n, S, S]
+    score tensor) runs the Pallas kernel per device; parity within flash
+    tolerances, causal + padding."""
+    q, k, v = _qkv(30)
+    am = _padding(31, 4, 64)
+    expected = attend(
+        q, k, v,
+        mask=jnp.logical_and(padding_mask(am), causal_mask(64, 64)),
+    )
+    got = ulysses_attention(
+        q, k, v, mask=am, causal=True, mesh=sp_mesh, local_impl="flash"
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), atol=2e-4
+    )
 
 
 def test_ulysses_validates(sp_mesh):

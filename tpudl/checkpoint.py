@@ -102,6 +102,12 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: TrainState) -> bool:
+        # INVARIANT callers rely on (tpudl.train.loop.fit donates the
+        # just-saved state's buffers to the next compiled step): Orbax's
+        # async save performs the device-to-host copy synchronously inside
+        # save() and only backgrounds the disk write. If the checkpoint
+        # backend ever changes to copy lazily, snapshot the payload here
+        # (e.g. jax.device_get on single-host) before returning.
         return self._mgr.save(
             step, args=ocp.args.StandardSave(_state_payload(state))
         )

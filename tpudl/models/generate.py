@@ -67,12 +67,14 @@ def generate(
     temperature: float = 0.0,
     eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
+    eos_check_every: int = 8,
 ) -> jax.Array:
     """Generate continuations for a [B, S] prompt batch.
 
     ``model`` is a LlamaForCausalLM whose config ``max_seq_len`` bounds
     S + max_new_tokens. Returns [B, max_new_tokens] generated ids (after
-    ``eos_id``, positions are padded with eos).
+    ``eos_id``, positions are padded with eos). ``eos_check_every`` paces
+    the all-rows-done early-exit readback (1 = check every token).
     """
     b, s = input_ids.shape
     if attention_mask is None:
@@ -81,6 +83,11 @@ def generate(
         raise NotImplementedError(
             "generate() requires unpadded prompts (attention_mask all "
             "ones): the KV cache indexes by slot == position"
+        )
+    if eos_check_every < 1:
+        raise ValueError(
+            f"eos_check_every must be >= 1 (1 = check every token), got "
+            f"{eos_check_every}"
         )
     if s + max_new_tokens > model.cfg.max_seq_len:
         raise ValueError(
@@ -105,7 +112,15 @@ def generate(
         tokens.append(token)
         if i + 1 == max_new_tokens:
             break
-        if eos_id is not None and bool(done.all()):
+        # Early-exit check only every `eos_check_every` tokens: a
+        # bool(done.all()) is a device readback that serializes decode
+        # dispatch (pathological on relay-attached devices), so the
+        # steady-state loop stays free of per-token host syncs.
+        if (
+            eos_id is not None
+            and (i + 1) % eos_check_every == 0
+            and bool(done.all())
+        ):
             # Every row finished: pad the rest with eos, skip dead steps.
             pad = jnp.full_like(token, eos_id)
             tokens.extend([pad] * (max_new_tokens - i - 1))

@@ -162,7 +162,7 @@ class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions, decode: bool = False):
+    def __call__(self, hidden, positions, kv_mask=None, decode: bool = False):
         cfg = self.cfg
         B, S, _ = hidden.shape
         hd = cfg.head_dim
@@ -224,8 +224,13 @@ class LlamaAttention(nn.Module):
         q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
         k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
         v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
+        # kv_mask ([B, S] validity row) masks padding alongside the causal
+        # triangle — without it a LEFT-padded batch would attend to pad
+        # garbage (causality only happens to hide trailing pads). All four
+        # attention implementations accept the [B, S] row contract.
         ctx = attend(
-            q, k, v, causal=True, implementation=cfg.attention_impl
+            q, k, v, mask=kv_mask, causal=True,
+            implementation=cfg.attention_impl,
         )
         ctx = ctx.reshape(B, S, cfg.num_heads * hd)
         return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
@@ -235,11 +240,12 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions, decode: bool = False):
+    def __call__(self, hidden, positions, kv_mask=None, decode: bool = False):
         cfg = self.cfg
         attn = LlamaAttention(cfg, name="attention")(
             RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden),
             positions,
+            kv_mask,
             decode,
         )
         hidden = hidden + attn
@@ -275,6 +281,9 @@ class LlamaModel(nn.Module):
         self, input_ids, attention_mask=None, decode=False, positions=None
     ):
         cfg = self.cfg
+        # kv_mask=None keeps the unpadded fast path (no in-kernel validity
+        # masking); any explicit attention_mask is enforced in attention.
+        kv_mask = attention_mask
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
         if positions is None:
@@ -293,9 +302,9 @@ class LlamaModel(nn.Module):
         x = constrain(x, ("dp", "fsdp"), "sp", "tp")
         block = LlamaBlock
         if cfg.remat and not decode:
-            block = nn.remat(LlamaBlock, static_argnums=(3,))
+            block = nn.remat(LlamaBlock, static_argnums=(4,))
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions, decode)
+            x = block(cfg, name=f"layer_{i}")(x, positions, kv_mask, decode)
         return RMSNorm(cfg.rms_norm_eps, name="final_norm")(x)
 
 

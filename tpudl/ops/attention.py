@@ -24,6 +24,14 @@ import jax.numpy as jnp
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def is_tpu_backend() -> bool:
+    """Whether the default JAX backend is a TPU — the one place the
+    platform list lives ("axon" is a TPU relay registered under another
+    platform name). Gates Pallas-kernel defaults: the TPU kernels lower
+    only here, and run interpreted elsewhere."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -91,6 +99,22 @@ def normalize_kv_mask(
     return jnp.broadcast_to(mask, (batch, kv_len)).astype(dtype)
 
 
+def combine_kv_causal_mask(
+    mask: Optional[jax.Array], q_len: int, kv_len: int, causal: bool
+) -> Optional[jax.Array]:
+    """The one mask-assembly rule every einsum-path implementation shares:
+    lift a [B, Skv] kv-validity row to [B, 1, 1, Skv] (4-D masks pass
+    through), then AND in the causal triangle when asked — a causal model
+    with padded batches must not see future positions just because a
+    padding mask is set. Returns None when nothing masks."""
+    if mask is not None and mask.ndim == 2:
+        mask = padding_mask(mask)
+    if causal:
+        tri = causal_mask(q_len, kv_len)
+        mask = tri if mask is None else jnp.logical_and(mask.astype(bool), tri)
+    return mask
+
+
 def unmeshed_attention(
     q: jax.Array,
     k: jax.Array,
@@ -101,14 +125,13 @@ def unmeshed_attention(
 ) -> jax.Array:
     """Single-device degenerate path for the sequence-parallel
     implementations: reference attention with the kv-validity mask and the
-    causal triangle correctly COMBINED (a causal model with padded batches
-    must not see future positions just because a padding mask is set)."""
-    kvm = normalize_kv_mask(mask, q.shape[0], k.shape[1]) if mask is not None else None
-    full = padding_mask(kvm) if kvm is not None else None
-    if causal:
-        tri = causal_mask(q.shape[1], k.shape[1])
-        full = tri if full is None else jnp.logical_and(full, tri)
-    return dot_product_attention(q, k, v, full, scale=scale)
+    causal triangle combined by combine_kv_causal_mask."""
+    if mask is not None:
+        mask = normalize_kv_mask(mask, q.shape[0], k.shape[1])
+    return dot_product_attention(
+        q, k, v, combine_kv_causal_mask(mask, q.shape[1], k.shape[1], causal),
+        scale=scale,
+    )
 
 
 def attend(
@@ -130,8 +153,10 @@ def attend(
       "ring"      — sequence-parallel ring attention over the `sp` mesh
                     axis (ppermute K/V rotation, online-softmax merge);
       "ulysses"   — sequence-parallel attention via all-to-all head/seq
-                    resharding over `sp` (exact reference numerics;
-                    requires local heads divisible by sp).
+                    resharding over `sp` (requires local heads divisible
+                    by sp; per-device body is flash on TPU, exact
+                    reference numerics on CPU — ulysses_attention's
+                    local_impl parameter pins either).
 
     Attention-probability dropout is only supported by the reference
     implementation; flash/ring/ulysses reject a nonzero rate rather than
@@ -144,8 +169,7 @@ def attend(
             "otherwise be silently skipped)"
         )
     if implementation == "reference":
-        if causal and mask is None:
-            mask = causal_mask(q.shape[1], k.shape[1])
+        mask = combine_kv_causal_mask(mask, q.shape[1], k.shape[1], causal)
         return dot_product_attention(
             q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng
         )

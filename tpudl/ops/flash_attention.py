@@ -46,9 +46,11 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _interpret_default() -> bool:
-    # Interpret mode on CPU so the same kernel runs in the hermetic test
+    # Interpret mode off-TPU so the same kernel runs in the hermetic test
     # environment (SURVEY.md §4.2) and compiled on TPU.
-    return jax.default_backend() == "cpu"
+    from tpudl.ops.attention import is_tpu_backend
+
+    return not is_tpu_backend()
 
 
 #: Grid semantics for every pallas_call here: batch/head/q axes carry no

@@ -16,8 +16,10 @@ Routing math (top-k, capacity-bounded):
   expert's capacity ``C = ceil(k * S * capacity_factor / E)`` are dropped
   (their combine weight is zero — the residual connection around the MoE
   layer carries them through unchanged);
-- gate values renormalized over the kept top-k so combine weights sum to
-  at most 1 per token;
+- gate values normalized by the FULL top-k gate sum (GShard-style):
+  combine weights sum to 1 only when all k choices were kept; a dropped
+  choice's mass shrinks the survivors' weights rather than being
+  reassigned to them;
 - Switch-style load-balance aux loss ``E * sum_e f_e * p_e`` (f = top-1
   dispatch fraction, p = mean router prob), sown into the
   ``intermediates`` collection as ``moe_aux_loss`` for the train loop to
@@ -89,11 +91,15 @@ def route_topk(probs: jax.Array, k: int, capacity: int):
         )  # [G, S, C]
         disp = keep[..., None] * slot[:, :, None, :]  # [G, S, E, C]
         dispatch = dispatch + disp
-        kept_gate = gate * jnp.sum(keep, -1)
         combine = combine + disp * gate[..., None, None]
-        gate_total = gate_total + kept_gate
+        gate_total = gate_total + gate
         remaining = remaining * (1.0 - mask)
 
+    # GShard/Switch normalization: divide by the sum of ALL top-k gates
+    # (kept or not), so a token whose higher-probability expert was
+    # capacity-dropped routes through its surviving choice with a
+    # correspondingly SMALLER combine weight — the dropped mass falls to
+    # the residual connection, it is not reassigned to the survivor.
     combine = combine / jnp.maximum(gate_total, 1e-9)[..., None, None]
 
     # Switch load-balance loss: E * sum_e (top-1 dispatch fraction) *

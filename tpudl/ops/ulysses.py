@@ -11,12 +11,13 @@ tpudl.ops.ring_attention:
   an online-softmax merge. Communication scales with S but overlaps.
 - **ulysses** (this module): two `all_to_all` collectives reshard
   activations from sequence-sharded [B, S/n, H, D] to head-sharded
-  [B, S, H/n, D]; in between, every device runs UNMODIFIED full-sequence
-  attention on its head slice. Exact same numerics as the reference
-  implementation by construction, any mask kind works locally, and the
-  all-to-all rides ICI's all-to-all bandwidth — but requires
-  heads % sp == 0, and peak activation memory holds the full sequence
-  for H/n heads.
+  [B, S, H/n, D]; in between, every device runs full-sequence attention
+  on its head slice. With ``local_impl="reference"`` the numerics are
+  exactly the reference implementation's by construction; the default on
+  TPU is ``local_impl="flash"`` (the Pallas kernel — flash-tolerance
+  numerics, but peak memory linear in S instead of the [B, H/n, S, S]
+  score tensor). The all-to-all rides ICI's all-to-all bandwidth;
+  requires heads % sp == 0.
 
 Which to use: ulysses while heads ≥ sp (cheap, exact, simple); ring when
 sequence length pushes past what a full-S slice of heads can hold or
@@ -35,11 +36,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpudl.runtime.mesh import AXIS_SEQ, BATCH_AXES, AXIS_TENSOR
 
 
-def _ulysses_local(q, k, v, kvm, *, axis_name, causal, scale):
+def _ulysses_local(q, k, v, kvm=None, *, axis_name, causal, scale, local_impl):
     """Per-device body. q/k/v: [B, S/n, H_local, D] (H_local = H/tp·... the
     heads remaining on this device's tp slice); kvm: [B, S] full-sequence
-    kv-validity row (replicated over sp)."""
-    from tpudl.ops.attention import causal_mask, dot_product_attention
+    kv-validity row (replicated over sp), or None when the caller passed
+    no mask — kept None so flash takes its maskless codegen path (no
+    per-tile kv-row traffic on the unmasked long-context hot path)."""
+    from tpudl.ops.attention import dot_product_attention
 
     n = jax.lax.psum(1, axis_name)
 
@@ -58,10 +61,24 @@ def _ulysses_local(q, k, v, kvm, *, axis_name, causal, scale):
     if n > 1:
         q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
 
-    mask = (kvm > 0)[:, None, None, :]
-    if causal:
-        mask = jnp.logical_and(mask, causal_mask(q.shape[1], k.shape[1]))
-    out = dot_product_attention(q, k, v, mask=mask, scale=scale)
+    if local_impl == "flash":
+        # Pallas flash kernel on the head slice: peak memory stays linear
+        # in S instead of materializing the [B, H/n, S, S] score tensor —
+        # the whole point of the long-context path ulysses serves.
+        from tpudl.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, mask=kvm, causal=causal, scale=scale)
+    else:
+        from tpudl.ops.attention import combine_kv_causal_mask
+
+        out = dot_product_attention(
+            q, k, v,
+            mask=combine_kv_causal_mask(
+                None if kvm is None else kvm > 0,
+                q.shape[1], k.shape[1], causal,
+            ),
+            scale=scale,
+        )
     if n > 1:
         out = heads_to_seq(out)
     return out
@@ -76,6 +93,7 @@ def ulysses_attention(
     scale: Optional[float] = None,
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_SEQ,
+    local_impl: Optional[str] = None,
 ) -> jax.Array:
     """Sequence-parallel attention on [B, S, H, D] via all-to-all
     (tpudl.ops.attention contract; Sq == Skv — one shared sequence axis).
@@ -84,13 +102,36 @@ def ulysses_attention(
     mask (dense masks are rejected, as in ring/flash). ``mesh`` defaults
     to the active tpudl mesh; batch shards over (dp, fsdp), sequence over
     `sp`, heads over `tp` — requires (H / tp) % sp == 0.
+
+    ``local_impl`` picks the per-device attention body: "flash" (Pallas
+    kernel — memory linear in S, the long-context default on TPU) or
+    "reference" (einsum — exact tpudl.ops.attention numerics, the default
+    on CPU where the kernel would run interpreted). None = by backend.
     """
     from tpudl.ops.attention import normalize_kv_mask, unmeshed_attention
     from tpudl.parallel.sharding import current_mesh
 
+    # Resolve + validate the local body BEFORE the unmeshed early-return,
+    # so an invalid value always errors and an explicitly pinned "flash"
+    # (chosen for its O(S) memory) is honored even without a mesh.
+    if local_impl is None:
+        from tpudl.ops.attention import is_tpu_backend
+
+        # Flash only where the Pallas TPU kernel lowers; cpu/gpu take the
+        # exact einsum body.
+        local_impl = "flash" if is_tpu_backend() else "reference"
+    if local_impl not in ("flash", "reference"):
+        raise ValueError(
+            f"local_impl must be 'flash' or 'reference', got {local_impl!r}"
+        )
+
     if mesh is None:
         mesh = current_mesh()
     if mesh is None:
+        if local_impl == "flash":
+            from tpudl.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, mask=mask, causal=causal, scale=scale)
         return unmeshed_attention(q, k, v, mask, causal, scale)
 
     b, s, h, d = q.shape
@@ -113,13 +154,24 @@ def ulysses_attention(
     if scale is None:
         scale = d ** -0.5
 
-    kvm = normalize_kv_mask(mask, b, s, impl="ulysses_attention")
-
     batch = tuple(a for a in BATCH_AXES if mesh.shape[a] > 1) or None
     heads = AXIS_TENSOR if h % max(n_tp, 1) == 0 and n_tp > 1 else None
     qkv_spec = P(batch, axis_name, heads, None)
+    body = partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                   scale=scale, local_impl=local_impl)
+    if mask is None:
+        # No kvm operand at all: flash keeps its maskless codegen path.
+        fn = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    kvm = normalize_kv_mask(mask, b, s, impl="ulysses_attention")
     fn = jax.shard_map(
-        partial(_ulysses_local, axis_name=axis_name, causal=causal, scale=scale),
+        body,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch, None)),
         out_specs=qkv_spec,

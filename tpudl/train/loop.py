@@ -298,6 +298,9 @@ def fit(
             if checkpoint_manager is not None and checkpoint_every:
                 step_no = start_step + n
                 if step_no % checkpoint_every == 0:
+                    # Safe despite the next step donating `state`'s
+                    # buffers: CheckpointManager.save copies device->host
+                    # before returning (see its docstring invariant).
                     checkpoint_manager.save(step_no, state)
             if log_every and (i + 1) % log_every == 0:
                 host_metrics = {k: float(v) for k, v in metrics.items()}
