@@ -1,0 +1,138 @@
+"""Decompose the configs[1] BERT-base step at seq 128 / batch 256.
+
+The round-2 verdict's MFU attack order starts with "make flash win at
+seq 128 or document why XLA wins there". This sweep measures the
+steady-state step under each attention implementation x dropout setting
+so the headline-path decision is data, not guesswork. Timing protocol as
+bench.py (warmup burst + scalar-readback windows).
+
+Run: python benchmarks/bert_attn_seq128.py [--batch 256] [--seq 128]
+"""
+
+import argparse
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.runtime import use_hardware_rng
+
+use_hardware_rng()
+
+from tpudl.config import get_config  # noqa: E402
+from tpudl.data.synthetic import synthetic_token_batches  # noqa: E402
+from tpudl.models.bert import BERT_BASE, BertForSequenceClassification  # noqa: E402
+from tpudl.runtime import MeshSpec, make_mesh  # noqa: E402
+from tpudl.train import (  # noqa: E402
+    compile_step,
+    create_train_state,
+    make_classification_train_step,
+)
+from tpudl.train.metrics import (  # noqa: E402
+    compiled_flops,
+    device_peak_flops,
+    mfu,
+)
+from tpudl.train.optim import make_optimizer  # noqa: E402
+
+WARMUP = 12
+MEASURE = 25
+
+
+def bench_variant(name, cfg_kwargs, batch_size, seq):
+    import dataclasses
+
+    ocfg = dataclasses.replace(
+        get_config("sst2_bert_base").optim, schedule="constant", warmup_steps=0
+    )
+    model = BertForSequenceClassification(BERT_BASE(num_labels=2, **cfg_kwargs))
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, seq), jnp.int32),
+        make_optimizer(ocfg),
+    )
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"), label_key="label"
+        ),
+        mesh,
+        state,
+        None,
+    )
+    batch = next(
+        synthetic_token_batches(batch_size, seq_len=seq, vocab_size=30_522)
+    )
+    batch = jax.device_put(batch)
+    rng = jax.random.key(1)
+
+    flops = compiled_flops(step.jitted.lower(state, batch, rng))
+
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+
+    start = time.perf_counter()
+    for _ in range(MEASURE):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - start
+
+    step_s = elapsed / MEASURE
+    sps = batch_size / step_s
+    m = mfu(flops, step_s, 1, device_peak_flops()) if flops else float("nan")
+    print(
+        f"{name:44s} {step_s * 1e3:8.2f} ms/step  {sps:8.1f} samples/s  "
+        f"mfu={m:.3f}"
+    )
+    return sps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--seq", type=int, default=128)
+    args = ap.parse_args()
+
+    print(f"BERT-base batch={args.batch} seq={args.seq} "
+          f"(warmup {WARMUP}, measure {MEASURE})")
+    bench_variant("reference, attn-drop 0.1 (headline)", {}, args.batch, args.seq)
+    bench_variant(
+        "reference, attn-drop 0.0",
+        {"attention_dropout": 0.0},
+        args.batch,
+        args.seq,
+    )
+    bench_variant(
+        "reference, all-drop 0.0",
+        {"attention_dropout": 0.0, "hidden_dropout": 0.0},
+        args.batch,
+        args.seq,
+    )
+    bench_variant(
+        "flash, attn-drop 0.0",
+        {"attention_dropout": 0.0, "attention_impl": "flash"},
+        args.batch,
+        args.seq,
+    )
+    bench_variant(
+        "fused, attn-drop 0.1 (headline candidate)",
+        {"attention_impl": "fused"},
+        args.batch,
+        args.seq,
+    )
+    bench_variant(
+        "fused, attn-drop 0.0",
+        {"attention_dropout": 0.0, "attention_impl": "fused"},
+        args.batch,
+        args.seq,
+    )
+
+
+if __name__ == "__main__":
+    main()

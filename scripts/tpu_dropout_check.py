@@ -1,0 +1,80 @@
+"""Real-TPU statistical checks for the in-kernel (PRNG-backed) dropout
+paths — the half of tpudl.ops.fused_attention / tpudl.ops.softmax_dropout
+that pallas interpret mode cannot emulate (no PRNG), so the CPU test tier
+(tests/test_fused_attention.py) cannot cover it.
+
+Run on a machine with a TPU: python scripts/tpu_dropout_check.py
+Prints PASS/FAIL per check; exits nonzero on failure; prints SKIP when
+no TPU backend is present (so CI without a chip stays green).
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.ops.attention import attend, is_tpu_backend
+from tpudl.ops.fused_attention import fused_attention
+from tpudl.ops.softmax_dropout import softmax_dropout
+
+
+def main() -> int:
+    if not is_tpu_backend():
+        print("SKIP: no TPU backend")
+        return 0
+    failures = 0
+
+    def check(name, ok):
+        nonlocal failures
+        print(f"{'PASS' if ok else 'FAIL'}: {name}")
+        failures += 0 if ok else 1
+
+    B, S, H, D = 4, 128, 8, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in ks)
+    rng = jax.random.key(42)
+
+    # Determinism: same key -> bit-identical outputs and grads.
+    o1 = fused_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng)
+    o2 = fused_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng)
+    check("fused fwd deterministic per key", bool(jnp.all(o1 == o2)))
+    o3 = fused_attention(q, k, v, dropout_rate=0.1,
+                         dropout_rng=jax.random.key(43))
+    check("fused fwd differs across keys", bool(jnp.any(o1 != o3)))
+    g1 = jax.grad(lambda q: jnp.sum(
+        fused_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng) ** 2
+    ))(q)
+    g2 = jax.grad(lambda q: jnp.sum(
+        fused_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng) ** 2
+    ))(q)
+    check("fused bwd deterministic per key", bool(jnp.all(g1 == g2)))
+    check("fused bwd finite", bool(jnp.all(jnp.isfinite(g1))))
+
+    # Expectation: mean over keys approaches the no-dropout output.
+    base = attend(q, k, v)
+    f = jax.jit(lambda r: fused_attention(
+        q, k, v, dropout_rate=0.1, dropout_rng=r
+    ))
+    acc = jnp.zeros_like(base)
+    n = 96
+    for i in range(n):
+        acc = acc + f(jax.random.key(100 + i))
+    err = float(jnp.mean(jnp.abs(acc / n - base)))
+    check(f"fused E[dropout out] ~ base (mean_abs {err:.4f})", err < 0.02)
+
+    # softmax_dropout keep fraction via uniform probabilities.
+    logits = jnp.zeros((2, 2, 128, 128))
+    p = softmax_dropout(logits, dropout_rate=0.1,
+                        dropout_rng=rng, out_dtype=jnp.float32)
+    # each kept element is (1/S)/(1-r); fraction kept ~ 1 - r
+    kept = float(jnp.mean((p > 0).astype(jnp.float32)))
+    check(f"softmax_dropout keep fraction {kept:.4f} ~ 0.9",
+          abs(kept - 0.9) < 0.01)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

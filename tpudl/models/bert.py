@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpudl.ops.attention import attend, padding_mask
+from tpudl.ops.dropout import Dropout
 from tpudl.parallel.sharding import constrain
 
 
@@ -46,6 +47,10 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
+    # True = bit-exact jax.random.bernoulli dropout masks; False (default,
+    # the headline-perf path) = low-width hardware bits, rate quantized to
+    # 1/256 (tpudl.ops.dropout).
+    dropout_exact: bool = False
     num_labels: int = 2
     dtype: Any = jnp.bfloat16
     attention_impl: str = "reference"
@@ -91,7 +96,7 @@ class BertEmbeddings(nn.Module):
         x = we + pe + te
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="layer_norm")(x)
-        x = nn.Dropout(cfg.hidden_dropout, deterministic=not train)(x)
+        x = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(x, deterministic=not train)
         return x.astype(cfg.dtype)
 
 
@@ -120,10 +125,11 @@ class BertSelfAttention(nn.Module):
             implementation=cfg.attention_impl,
             dropout_rate=cfg.attention_dropout if train else 0.0,
             dropout_rng=attn_dropout_rng,
+            dropout_exact=cfg.dropout_exact,
         )
         ctx = ctx.reshape(B, S, cfg.hidden_size)
         out = _dense(cfg, cfg.hidden_size, "out")(ctx)
-        out = nn.Dropout(cfg.hidden_dropout, deterministic=not train)(out)
+        out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(out, deterministic=not train)
         return out
 
 
@@ -143,7 +149,7 @@ class BertLayer(nn.Module):
         inter = _dense(cfg, cfg.intermediate_size, "intermediate")(hidden)
         inter = nn.gelu(inter, approximate=False)
         out = _dense(cfg, cfg.hidden_size, "output")(inter)
-        out = nn.Dropout(cfg.hidden_dropout, deterministic=not train)(out)
+        out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(out, deterministic=not train)
         hidden = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="output_norm"
         )(hidden + out).astype(cfg.dtype)
@@ -209,8 +215,8 @@ class BertForSequenceClassification(nn.Module):
         _, pooled = BertModel(self.cfg, name="bert")(
             input_ids, attention_mask, token_type_ids, train
         )
-        pooled = nn.Dropout(self.cfg.hidden_dropout, deterministic=not train)(
-            pooled
+        pooled = Dropout(self.cfg.hidden_dropout, exact=self.cfg.dropout_exact)(
+            pooled, deterministic=not train
         )
         logits = nn.Dense(
             self.cfg.num_labels,
@@ -279,10 +285,12 @@ def params_from_hf_bert(
 
     Ignored HF keys: position_ids buffers and the cls.* pretraining heads.
     """
+    from tpudl.models.llama import _tensor_to_numpy
+
     tree: Dict = {}
     unmapped = []
     for hf_name, value in state_dict.items():
-        arr = np.asarray(getattr(value, "numpy", lambda: value)())
+        arr = _tensor_to_numpy(value)
         for pattern, template, transpose in _HF_MAP:
             m = re.match(pattern, hf_name)
             if m:

@@ -15,6 +15,7 @@ optimizer's job — see lora.lora_optimizer).
 from __future__ import annotations
 
 import dataclasses
+import re
 from functools import partial
 from typing import Any
 
@@ -350,6 +351,137 @@ class LlamaForSequenceClassification(nn.Module):
             name="classifier",
         )(pooled)
         return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# HuggingFace weight import (torch state_dict -> tpudl param tree).
+#
+# The reference's first act is loading pretrained weights
+# (reference notebooks/cv/onnx_experiments.py:19, resnet50(pretrained=True))
+# and BASELINE.json configs[4] is a *pretrained* Llama LoRA fine-tune —
+# random-init fine-tuning is not the workload. Same recipe as
+# tpudl.models.bert.params_from_hf_bert: regex map, transpose Linear
+# kernels, keep norms/embeddings as-is.
+# ---------------------------------------------------------------------------
+
+#: HF name pattern -> tpudl path template; bool = transpose ([out,in] ->
+#: [in,out]). Conventions verified against this module: rotate-half RoPE,
+#: consecutive-group GQA (q head h uses kv head h // (H/Hkv)), silu-gated
+#: MLP, f32 RMSNorm — all match HF's modeling_llama semantics, so the map
+#: is pure renaming + kernel transposes.
+_HF_LLAMA_MAP = [
+    (r"^model\.embed_tokens\.weight$", "model/embed_tokens/embedding", False),
+    (r"^model\.layers\.(\d+)\.self_attn\.(q|k|v|o)_proj\.weight$",
+     "model/layer_{0}/attention/{1}_proj/kernel", True),
+    (r"^model\.layers\.(\d+)\.mlp\.(gate|up|down)_proj\.weight$",
+     "model/layer_{0}/{1}_proj/kernel", True),
+    (r"^model\.layers\.(\d+)\.input_layernorm\.weight$",
+     "model/layer_{0}/input_norm/scale", False),
+    (r"^model\.layers\.(\d+)\.post_attention_layernorm\.weight$",
+     "model/layer_{0}/post_attention_norm/scale", False),
+    (r"^model\.norm\.weight$", "model/final_norm/scale", False),
+    (r"^lm_head\.weight$", "lm_head/kernel", True),
+    # HF LlamaForSequenceClassification names its head `score`.
+    (r"^score\.weight$", "classifier/kernel", True),
+    (r"^score\.bias$", "classifier/bias", False),
+]
+
+
+def _tensor_to_numpy(value):
+    """torch tensor (any dtype, incl. bfloat16 — the dtype pretrained
+    Llama checkpoints ship in, which Tensor.numpy() refuses) or array-like
+    -> numpy array."""
+    import numpy as _np
+
+    if hasattr(value, "detach"):  # torch tensor
+        value = value.detach()
+        try:
+            return value.numpy()
+        except TypeError:  # bf16/f8: upcast through f32
+            return value.float().numpy()
+    return _np.asarray(value)
+
+
+def params_from_hf_llama(state_dict, like=None):
+    """Convert a HF Llama state_dict (LlamaForCausalLM or
+    LlamaForSequenceClassification; torch tensors or numpy arrays) to a
+    tpudl param tree.
+
+    With ``like`` (a template tree from ``model.init``), mapped leaves are
+    grafted into a copy of it — unmapped template leaves (e.g. LoRA
+    adapters, a fresh classifier head) keep their initialized values, and
+    every graft is shape-checked. Tied-embedding checkpoints (no
+    ``lm_head.weight``) fall back to the transposed token embedding when
+    the template wants an ``lm_head``.
+    """
+    converted: dict = {}
+    unmapped = []
+    for hf_name, value in state_dict.items():
+        arr = _tensor_to_numpy(value)
+        for pattern, template, transpose in _HF_LLAMA_MAP:
+            m = re.match(pattern, hf_name)
+            if m:
+                converted[template.format(*m.groups())] = (
+                    arr.T if transpose else arr
+                )
+                break
+        else:
+            if not (
+                "rotary_emb" in hf_name or hf_name.endswith("position_ids")
+            ):
+                unmapped.append(hf_name)
+    if unmapped:
+        raise ValueError(f"unmapped HF parameters: {unmapped}")
+    if (
+        "lm_head/kernel" not in converted
+        and "model/embed_tokens/embedding" in converted
+    ):
+        # tie_word_embeddings: the output head shares the embedding.
+        converted["lm_head/kernel"] = converted[
+            "model/embed_tokens/embedding"
+        ].T
+
+    if like is None:
+        tree: dict = {}
+        for path, arr in converted.items():
+            node = tree
+            parts = path.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = jnp.asarray(arr)
+        return tree
+
+    tree = jax.tree.map(lambda x: x, like)  # shallow-copied structure
+    used = set()
+
+    def _graft(node, prefix):
+        out = {}
+        for name, leaf in node.items():
+            path = f"{prefix}/{name}" if prefix else name
+            if isinstance(leaf, dict):
+                out[name] = _graft(leaf, path)
+            elif path in converted:
+                arr = converted[path]
+                if tuple(arr.shape) != tuple(jnp.shape(leaf)):
+                    raise ValueError(
+                        f"shape mismatch at {path}: HF {arr.shape} vs "
+                        f"model {jnp.shape(leaf)}"
+                    )
+                used.add(path)
+                out[name] = jnp.asarray(arr, dtype=leaf.dtype)
+            else:
+                out[name] = leaf  # keep init (LoRA adapters, fresh heads)
+        return out
+
+    tree = _graft(dict(tree), "")
+    unused = set(converted) - used - {"lm_head/kernel", "classifier/kernel",
+                                      "classifier/bias"}
+    if unused:
+        raise ValueError(
+            f"HF parameters with no destination in the template: "
+            f"{sorted(unused)}"
+        )
+    return tree
 
 
 def build_llama(name: str, num_classes: int, dtype=jnp.bfloat16, **kwargs):

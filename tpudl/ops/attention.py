@@ -40,12 +40,15 @@ def dot_product_attention(
     scale: Optional[float] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    dropout_exact: bool = False,
 ) -> jax.Array:
     """Reference attention: bf16 matmuls on the MXU, softmax in f32.
 
     q: [B, Sq, H, D]; k, v: [B, Skv, H, D]; returns [B, Sq, H, D].
     ``dropout_rate`` drops attention probabilities (BERT-style) when a
-    ``dropout_rng`` is supplied.
+    ``dropout_rng`` is supplied — via low-width hardware bits by default
+    (rate quantized to 1/256, tpudl.ops.dropout); ``dropout_exact=True``
+    restores bit-exact jax.random.bernoulli masks (4x the bit traffic).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -56,7 +59,14 @@ def dot_product_attention(
     weights = jax.nn.softmax(logits, axis=-1)
     weights = weights.astype(v.dtype)
     if dropout_rate > 0.0 and dropout_rng is not None:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
+        from tpudl.ops.dropout import dropout_keep_mask
+
+        # Low-width-bits mask (tpudl.ops.dropout): 4x less random-bit
+        # traffic than bernoulli — 14.5 ms/step on the headline BERT
+        # fine-tune; rate quantizes to 1/256 unless dropout_exact.
+        keep = dropout_keep_mask(
+            dropout_rng, weights.shape, dropout_rate, exact=dropout_exact
+        )
         weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0).astype(
             v.dtype
         )
@@ -144,11 +154,16 @@ def attend(
     causal: bool = False,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    dropout_exact: bool = False,
 ) -> jax.Array:
     """Dispatch to an attention implementation.
 
     implementation:
       "reference" — this module's einsum attention (any backend);
+      "fused"     — Pallas TPU fused short-seq kernel (full softmax per
+                    cell, one-pass backward, IN-KERNEL attention dropout
+                    from the hardware PRNG — the only non-reference
+                    implementation that supports dropout_rate > 0);
       "flash"     — Pallas TPU flash-attention kernel;
       "ring"      — sequence-parallel ring attention over the `sp` mesh
                     axis (ppermute K/V rotation, online-softmax merge);
@@ -158,8 +173,8 @@ def attend(
                     reference numerics on CPU — ulysses_attention's
                     local_impl parameter pins either).
 
-    Attention-probability dropout is only supported by the reference
-    implementation; flash/ring/ulysses reject a nonzero rate rather than
+    Attention-probability dropout is supported by the reference and fused
+    implementations; flash/ring/ulysses reject a nonzero rate rather than
     silently dropping it (fine-tune with attention_dropout=0 on those
     paths).
     """
@@ -168,10 +183,35 @@ def attend(
             "dropout_rate > 0 requires a dropout_rng (dropout would "
             "otherwise be silently skipped)"
         )
+    if dropout_exact and implementation != "reference":
+        raise ValueError(
+            "dropout_exact (bit-exact bernoulli masks) is only available "
+            "on implementation='reference'; the fused kernel draws from "
+            "the TPU hardware PRNG"
+        )
     if implementation == "reference":
         mask = combine_kv_causal_mask(mask, q.shape[1], k.shape[1], causal)
         return dot_product_attention(
-            q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng
+            q, k, v, mask, dropout_rate=dropout_rate,
+            dropout_rng=dropout_rng, dropout_exact=dropout_exact,
+        )
+    if implementation == "fused":
+        # Two regimes (measured, benchmarks/bert_attn_seq128.py): at short
+        # S, XLA's batched matmuls are unbeatable and only softmax+dropout
+        # is worth fusing (hybrid); at longer S the whole-attention kernel
+        # avoids the growing [S, S] HBM round trips with big-enough dots.
+        if q.shape[1] <= 256:
+            from tpudl.ops.softmax_dropout import hybrid_attention
+
+            return hybrid_attention(
+                q, k, v, mask=mask, causal=causal,
+                dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+            )
+        from tpudl.ops.fused_attention import fused_attention
+
+        return fused_attention(
+            q, k, v, mask=mask, causal=causal,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng,
         )
     if dropout_rate > 0.0:
         raise ValueError(

@@ -1,0 +1,46 @@
+"""Shared helpers for the tpudl Pallas TPU kernels.
+
+The cell-seeding + threshold recipe here is a forward/backward
+bit-exactness CONTRACT: fused_attention and softmax_dropout regenerate
+their dropout masks in the backward pass by reseeding with exactly this
+scheme — any change must keep both passes (and both modules) in lockstep,
+which is why there is one copy.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def seed_cell(seed_ref, cell) -> None:
+    """Seed the TPU PRNG with a distinct stream per grid cell: prng_seed
+    takes at most two 32-bit words, so the flattened cell id folds into
+    them arithmetically — distinct cells get distinct (s0, s1) pairs for
+    any key."""
+    s0 = seed_ref[0] + cell.astype(jnp.uint32)
+    s1 = seed_ref[1] ^ (cell.astype(jnp.uint32) * jnp.uint32(2654435761))
+    pltpu.prng_seed(s0, s1)
+
+
+def flat_cell_id(grid_rank: int):
+    """Row-major flattened id of the current grid cell."""
+    cell = pl.program_id(0)
+    for axis in range(1, grid_rank):
+        cell = cell * pl.num_programs(axis) + pl.program_id(axis)
+    return cell
+
+
+def keep_mask(shape, rate: float):
+    """In-kernel dropout keep-mask from the hardware PRNG (True = keep
+    with probability 1 - rate). prng_random_bits yields int32 on TPU —
+    reinterpret as uint32 or the threshold compare drops ~55% instead of
+    ``rate``."""
+    threshold = jnp.uint32(round(rate * (2.0 ** 32)))
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return bits >= threshold
