@@ -36,8 +36,8 @@ from tpudl.parallel.sharding import TP_TRANSFORMER_RULES
 from tpudl.runtime import MeshSpec, make_mesh
 from tpudl.train import (
     MetricLogger,
+    TrainState,
     compile_step,
-    create_train_state,
     fit,
     make_classification_train_step,
 )
@@ -53,6 +53,13 @@ def main():
     parser.add_argument("--mesh", type=str, default=None,
                         help="dp,fsdp,sp,tp (e.g. 2,2,1,2); default all-dp")
     parser.add_argument("--log-dir", type=str, default=None)
+    parser.add_argument(
+        "--hf-checkpoint", type=str, default=None,
+        help="local HuggingFace Llama checkpoint directory: base weights "
+        "are grafted onto the model before LoRA fine-tuning (the actual "
+        "configs[4] workload — pretrained, not random-init); adapters and "
+        "the classifier head keep their fresh init",
+    )
     args = parser.parse_args()
 
     cfg = get_config("llama3_8b_lora", model=args.model)
@@ -60,14 +67,25 @@ def main():
 
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
     params = model.init(jax.random.key(cfg.seed), sample)["params"]
+    if args.hf_checkpoint:
+        import transformers
+
+        from tpudl.models.llama import params_from_hf_llama
+
+        hf = transformers.AutoModelForCausalLM.from_pretrained(
+            args.hf_checkpoint, local_files_only=True
+        )
+        params = params_from_hf_llama(hf.state_dict(), like=params)
+        print(f"grafted pretrained weights from {args.hf_checkpoint}")
     trainable, total = trainable_param_count(params, ("classifier",))
     print(f"{cfg.model}: {total/1e6:.1f}M params, "
           f"{trainable/1e6:.3f}M trainable ({100*trainable/total:.2f}%)")
 
     tx = lora_optimizer(make_optimizer(cfg.optim), params, ("classifier",))
-    state = create_train_state(
-        jax.random.key(cfg.seed), model, sample, tx, init_kwargs={}
-    )
+    # Build the state directly from the already-initialized (possibly
+    # HF-grafted) tree — create_train_state would run a second full init
+    # only to throw it away (2x startup cost at 8B scale).
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
     if args.mesh:
         mesh_spec = MeshSpec(*(int(x) for x in args.mesh.split(",")))

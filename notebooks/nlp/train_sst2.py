@@ -56,9 +56,16 @@ def main():
                         help="SST-2-schema Parquet dataset directory")
     parser.add_argument("--materialize", action="store_true",
                         help="generate a synthetic dataset into --data-dir first")
+    parser.add_argument(
+        "--text-data", action="store_true",
+        help="raw-text vertical: materialize a TEXT-schema dataset "
+        "(sentence, label) under --data-dir, train a first-party WordPiece "
+        "vocab on it, tokenize into an ids dataset, and fine-tune on that "
+        "— text -> ids -> fine-tune in one command",
+    )
     args = parser.parse_args()
-    if args.materialize and not args.data_dir:
-        parser.error("--materialize requires --data-dir")
+    if (args.materialize or args.text_data) and not args.data_dir:
+        parser.error("--materialize/--text-data require --data-dir")
 
     cfg = get_config("sst2_bert_base")
     if args.model:
@@ -91,7 +98,53 @@ def main():
     )
 
     warmup_steps = 2
-    if args.data_dir:
+    if args.text_data:
+        import os
+
+        from tpudl.data.datasets import (
+            materialize_sst2_text,
+            normalize_sst2_batch,
+            tokenize_text_dataset,
+        )
+        from tpudl.data.tokenizer import (
+            WordPieceTokenizer,
+            build_wordpiece_vocab,
+        )
+
+        from tpudl.data.converter import make_converter as _mk
+
+        text_dir = os.path.join(args.data_dir, "text")
+        ids_dir = os.path.join(args.data_dir, "ids")
+        vocab_path = os.path.join(args.data_dir, "vocab.txt")
+        if os.path.isdir(ids_dir) and not args.materialize:
+            # Petastorm contract: materialize once, train many. Pass
+            # --materialize to force regeneration.
+            print(f"reusing tokenized dataset {ids_dir} (vocab {vocab_path})")
+            conv = _mk(ids_dir)
+        else:
+            text_conv = materialize_sst2_text(text_dir, num_rows=8_192)
+            corpus = (
+                str(s)
+                for b in text_conv.make_batch_iterator(
+                    1024, epochs=1, shuffle=False, drop_last=False,
+                    columns=("sentence",),
+                )
+                for s in b["sentence"]
+            )
+            tok = WordPieceTokenizer(build_wordpiece_vocab(corpus, 4096))
+            tok.save_vocab(vocab_path)
+            print(f"trained WordPiece vocab ({len(tok.vocab)} tokens) -> "
+                  f"{vocab_path}")
+            conv = tokenize_text_dataset(
+                text_dir, ids_dir, tok, seq_len=seq_len
+            )
+        raw = (
+            normalize_sst2_batch(b)
+            for b in conv.make_batch_iterator(
+                batch_size, epochs=None, shuffle=True, seed=cfg.seed
+            )
+        )
+    elif args.data_dir:
         from tpudl.data.datasets import materialize_sst2_like, normalize_sst2_batch
 
         if args.materialize:

@@ -1,0 +1,164 @@
+"""WordPiece tokenizer (tpudl.data.tokenizer): HF parity + the raw-text
+-> ids -> fine-tune vertical.
+
+Parity discipline follows the model-weight imports: a
+transformers.BertTokenizer built OFFLINE from the same vocab file must
+produce identical ids/masks (no downloads — zero-egress environment)."""
+
+import numpy as np
+import pytest
+
+from tpudl.data.tokenizer import (
+    CLS,
+    PAD,
+    SEP,
+    UNK,
+    WordPieceTokenizer,
+    basic_tokenize,
+    build_wordpiece_vocab,
+)
+
+CORPUS = [
+    "A wonderful, heartfelt film — truly moving!",
+    "the plot was dreadful and the acting hollow.",
+    "Quite charming; superb direction, dazzling camera work.",
+    "boring... just boring. tedious pacing, bland script.",
+    "An engaging story about a warm friendship.",
+    "Café naïve résumé coöperate!",  # accents must strip
+    "unbelievable unbelievably believable",
+    "it's a don't-miss movie (really).",
+]
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return WordPieceTokenizer(build_wordpiece_vocab(CORPUS, 2048))
+
+
+def test_basic_tokenize_rules():
+    assert basic_tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+    assert basic_tokenize("café") == ["cafe"]  # accent stripped
+    assert basic_tokenize("don't") == ["don", "'", "t"]
+    assert basic_tokenize("  spaced\tout\n") == ["spaced", "out"]
+    assert basic_tokenize("漢字ab") == ["漢", "字", "ab"]  # CJK chars split
+
+
+def test_vocab_has_specials_first(tok):
+    assert tok.vocab[PAD] == 0
+    assert {UNK, CLS, SEP} <= set(tok.vocab)
+
+
+def test_roundtrip_known_words(tok):
+    for text in CORPUS:
+        pieces = tok.tokenize(text)
+        assert UNK not in pieces, (text, pieces)
+        # de-wordpiece reassembles the basic-tokenized text
+        rebuilt = "".join(p[2:] if p.startswith("##") else " " + p
+                          for p in pieces).split()
+        assert rebuilt == basic_tokenize(text)
+
+
+def test_encode_shape_and_truncation(tok):
+    ids, mask = tok.encode("a wonderful film", max_len=8)
+    assert len(ids) == len(mask) == 8
+    assert ids[0] == tok.cls_id and tok.sep_id in ids
+    assert mask[: ids.index(tok.pad_id) if tok.pad_id in ids else 8] == [1] * (
+        ids.index(tok.pad_id) if tok.pad_id in ids else 8
+    )
+    long_ids, long_mask = tok.encode(" ".join(["word"] * 100), max_len=16)
+    assert len(long_ids) == 16 and long_ids[-1] == tok.sep_id
+    assert sum(long_mask) == 16
+
+
+def test_batch_call(tok):
+    enc = tok(["great movie", "dreadful film, truly tedious"], max_len=12)
+    assert enc["input_ids"].shape == (2, 12)
+    assert enc["attention_mask"].dtype == np.int32
+
+
+def test_hf_parity_same_vocab_file(tok, tmp_path):
+    """Byte-parity with transformers.BertTokenizer over our vocab file:
+    ids AND attention masks identical across punctuation, accents,
+    unknowns, truncation, and padding."""
+    transformers = pytest.importorskip("transformers")
+    vocab_path = tmp_path / "vocab.txt"
+    tok.save_vocab(str(vocab_path))
+    hf = transformers.BertTokenizer(
+        str(vocab_path), do_lower_case=True, local_files_only=True
+    )
+    texts = CORPUS + [
+        "completely-unseen zxqv tokens!!",
+        "MiXeD CaSe And   WEIRD   spacing",
+        "truncate " + "very " * 60 + "long",
+    ]
+    for text in texts:
+        ours_ids, ours_mask = tok.encode(text, max_len=32)
+        hf_enc = hf(
+            text, max_length=32, truncation=True, padding="max_length"
+        )
+        assert ours_ids == hf_enc["input_ids"], text
+        assert ours_mask == hf_enc["attention_mask"], text
+
+
+def test_vocab_file_roundtrip(tok, tmp_path):
+    path = tmp_path / "vocab.txt"
+    tok.save_vocab(str(path))
+    tok2 = WordPieceTokenizer.from_vocab_file(str(path))
+    assert tok2.vocab == tok.vocab
+
+
+def test_text_dataset_to_ids_to_training(tmp_path):
+    """The full vertical: raw-text Parquet -> trained vocab -> ids Parquet
+    -> BERT fine-tune; loss decreases (the text signal is learnable)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.data.datasets import (
+        materialize_sst2_text,
+        normalize_sst2_batch,
+        tokenize_text_dataset,
+    )
+    from tpudl.models.bert import BERT_TINY, BertForSequenceClassification
+    from tpudl.train import create_train_state, make_classification_train_step
+
+    text_dir = str(tmp_path / "text")
+    ids_dir = str(tmp_path / "ids")
+    text_conv = materialize_sst2_text(text_dir, num_rows=512)
+    corpus = [
+        str(s)
+        for b in text_conv.make_batch_iterator(
+            128, epochs=1, shuffle=False, drop_last=False
+        )
+        for s in b["sentence"]
+    ]
+    tok = WordPieceTokenizer(build_wordpiece_vocab(corpus, 1024))
+    conv = tokenize_text_dataset(text_dir, ids_dir, tok, seq_len=32)
+
+    model = BertForSequenceClassification(
+        BERT_TINY(vocab_size=1024, num_heads=2, dtype=jnp.float32,
+                  max_position_embeddings=64)
+    )
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, 32), jnp.int32),
+        optax.adamw(3e-3),
+    )
+    step = jax.jit(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"), label_key="label"
+        )
+    )
+    rng = jax.random.key(1)
+    first = last = None
+    for i, batch in enumerate(
+        conv.make_batch_iterator(64, epochs=None, shuffle=True)
+    ):
+        if i >= 40:
+            break
+        state, metrics = step(state, normalize_sst2_batch(batch), rng)
+        if first is None:
+            first = float(metrics["loss"])
+        last = float(metrics["loss"])
+    assert last < first * 0.8, (first, last)

@@ -50,6 +50,7 @@ def write_parquet(
     columns: Dict[str, np.ndarray],
     rows_per_file: int = 4096,
     row_group_size: Optional[int] = None,
+    part_offset: int = 0,
 ) -> List[str]:
     """Write a dict of equal-length arrays as a multi-file Parquet dataset.
 
@@ -57,7 +58,10 @@ def write_parquet(
     stored in field metadata, so readers can restore the tensors.
     ``row_group_size`` bounds rows per Parquet row group (the converter's
     streaming granularity — smaller groups cap reader memory on wide
-    rows); default is one group per file.
+    rows); default is one group per file. ``part_offset`` shifts the
+    part-file numbering so incremental writers (e.g.
+    tpudl.data.datasets.tokenize_text_dataset) can append chunks to one
+    dataset directory across calls without filename collisions.
     """
     if not HAVE_PYARROW:
         raise RuntimeError("pyarrow is required for the Parquet data layer")
@@ -94,7 +98,7 @@ def write_parquet(
     paths = []
     for i, start in enumerate(range(0, n, rows_per_file)):
         chunk = table.slice(start, rows_per_file)
-        path = os.path.join(directory, f"part-{i:05d}.parquet")
+        path = os.path.join(directory, f"part-{part_offset + i:05d}.parquet")
         pq.write_table(chunk, path, row_group_size=row_group_size)
         paths.append(path)
     return paths

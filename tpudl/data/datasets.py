@@ -144,3 +144,119 @@ def normalize_sst2_batch(batch: dict) -> dict:
         "attention_mask": batch["attention_mask"].astype(np.int32),
         "label": batch["label"].astype(np.int32),
     }
+
+
+# ---------------------------------------------------------------------------
+# Raw-text SST-2 path (tokenizer vertical).
+# ---------------------------------------------------------------------------
+
+#: Tiny sentiment lexicons for the synthetic raw-text corpus: the label
+#: signal is carried by natural-language words, so the full
+#: text -> WordPiece -> ids -> fine-tune pipeline is learnable end-to-end.
+_POSITIVE = (
+    "wonderful great delightful brilliant moving charming superb "
+    "heartfelt dazzling triumphant funny warm engaging masterful fresh"
+).split()
+_NEGATIVE = (
+    "dreadful boring tedious clumsy hollow lifeless bland grating "
+    "shallow messy dull forgettable awkward stale tiresome"
+).split()
+_FILLER = (
+    "the a this that film movie story plot acting cast script scene "
+    "direction pacing and but with about feels is was rather quite "
+    "truly somewhat performance ending dialogue camera moments it"
+).split()
+
+
+def synthetic_review(rng, label: int, min_words: int = 6,
+                     max_words: int = 24) -> str:
+    """One synthetic review sentence whose sentiment words match `label`."""
+    n = int(rng.integers(min_words, max_words + 1))
+    lexicon = _POSITIVE if label == 1 else _NEGATIVE
+    words = []
+    for _ in range(n):
+        if rng.random() < 0.25:
+            words.append(lexicon[int(rng.integers(0, len(lexicon)))])
+        else:
+            words.append(_FILLER[int(rng.integers(0, len(_FILLER)))])
+    sentence = " ".join(words)
+    if rng.random() < 0.3:
+        sentence += "."
+    return sentence
+
+
+def materialize_sst2_text(
+    directory: str,
+    num_rows: int = 8_192,
+    seed: int = 0,
+    rows_per_file: int = 2048,
+):
+    """RAW-TEXT SST-2-schema Parquet dataset (sentence: str, label: int64)
+    — the true shape of the reference workload's input (SST-2 is a text
+    dataset; the reference's analog is raw-image preprocessing at
+    reference notebooks/cv/onnx_experiments.py:55-66). Feed through
+    tokenize_text_dataset to get the ids-schema dataset the training
+    pipeline consumes."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 2, size=(num_rows,))
+    sentences = np.asarray(
+        [synthetic_review(rng, int(lab)) for lab in labels], dtype=object
+    )
+    write_parquet(
+        directory,
+        {"sentence": sentences, "label": labels.astype(np.int64)},
+        rows_per_file=rows_per_file,
+    )
+    return make_converter(directory)
+
+
+def tokenize_text_dataset(
+    text_dir: str,
+    out_dir: str,
+    tokenizer,
+    seq_len: int = 128,
+    batch_size: int = 1024,
+    rows_per_file: int = 2048,
+):
+    """text-schema Parquet -> ids-schema Parquet (the preprocessing step of
+    the Petastorm contract: materialize once, train many).
+
+    ``tokenizer``: a tpudl.data.tokenizer.WordPieceTokenizer (or anything
+    with its __call__(texts, max_len) -> {input_ids, attention_mask}).
+    Genuinely streaming: one text batch is tokenized and flushed to its
+    own part-file at a time (write_parquet part_offset), so peak memory
+    is one chunk regardless of corpus size.
+    """
+    conv = make_converter(text_dir)
+    buf_ids, buf_mask, buf_labels, buffered = [], [], [], 0
+    part = 0
+
+    def _flush():
+        nonlocal part, buf_ids, buf_mask, buf_labels, buffered
+        if not buffered:
+            return
+        write_parquet(
+            out_dir,
+            {
+                "input_ids": np.concatenate(buf_ids),
+                "attention_mask": np.concatenate(buf_mask),
+                "label": np.concatenate(buf_labels),
+            },
+            rows_per_file=rows_per_file,
+            part_offset=part,
+        )
+        part += -(-buffered // rows_per_file)
+        buf_ids, buf_mask, buf_labels, buffered = [], [], [], 0
+
+    for batch in conv.make_batch_iterator(
+        batch_size, epochs=1, shuffle=False, drop_last=False
+    ):
+        enc = tokenizer([str(s) for s in batch["sentence"]], seq_len)
+        buf_ids.append(enc["input_ids"].astype(np.int64))
+        buf_mask.append(enc["attention_mask"].astype(np.int64))
+        buf_labels.append(batch["label"].astype(np.int64))
+        buffered += len(batch["label"])
+        if buffered >= rows_per_file:
+            _flush()
+    _flush()
+    return make_converter(out_dir)

@@ -59,15 +59,18 @@ def dot_product_attention(
     weights = jax.nn.softmax(logits, axis=-1)
     weights = weights.astype(v.dtype)
     if dropout_rate > 0.0 and dropout_rng is not None:
-        from tpudl.ops.dropout import dropout_keep_mask
+        from tpudl.ops.dropout import dropout_keep_mask, quantized_rate
 
         # Low-width-bits mask (tpudl.ops.dropout): 4x less random-bit
         # traffic than bernoulli — 14.5 ms/step on the headline BERT
-        # fine-tune; rate quantizes to 1/256 unless dropout_exact.
+        # fine-tune; rate quantizes to 1/256 unless dropout_exact, and
+        # the rescale uses the EFFECTIVE (quantized) rate so expectation
+        # is preserved exactly.
         keep = dropout_keep_mask(
             dropout_rng, weights.shape, dropout_rate, exact=dropout_exact
         )
-        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0).astype(
+        eff = quantized_rate(dropout_rate, dropout_exact)
+        weights = jnp.where(keep, weights / (1.0 - eff), 0.0).astype(
             v.dtype
         )
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
@@ -183,7 +186,7 @@ def attend(
             "dropout_rate > 0 requires a dropout_rng (dropout would "
             "otherwise be silently skipped)"
         )
-    if dropout_exact and implementation != "reference":
+    if dropout_exact and dropout_rate > 0.0 and implementation != "reference":
         raise ValueError(
             "dropout_exact (bit-exact bernoulli masks) is only available "
             "on implementation='reference'; the fused kernel draws from "

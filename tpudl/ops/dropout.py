@@ -29,24 +29,36 @@ import jax
 import jax.numpy as jnp
 
 
+def quantized_rate(rate: float, exact: bool = False) -> float:
+    """The EFFECTIVE drop rate of dropout_keep_mask: on the uint8 path
+    the requested rate rounds to threshold/256. Inverted-dropout rescale
+    must use this value, not the nominal rate, or E[output] drifts from
+    the input by the quantization gap (~0.17% at rate 0.1)."""
+    if exact or rate <= 0.0:
+        return rate
+    if rate >= 1.0:
+        return 1.0
+    return min(int(round(rate * 256.0)), 255) / 256.0
+
+
 def dropout_keep_mask(
     rng: jax.Array, shape, rate: float, exact: bool = False
 ) -> jax.Array:
-    """Boolean keep-mask: True with probability ~(1 - rate).
+    """Boolean keep-mask: True with probability 1 - quantized_rate(rate).
 
     ``exact=False`` (default) uses uint8 random bits — rate quantized to
-    ceil-free round(rate * 256) / 256; ``exact=True`` uses
-    jax.random.bernoulli (f32-uniform compare, 4x the bit traffic).
+    round(rate * 256) / 256; ``exact=True`` uses jax.random.bernoulli
+    (f32-uniform compare, 4x the bit traffic).
     """
     if exact:
         return jax.random.bernoulli(rng, 1.0 - rate, shape)
     if rate >= 1.0:
         return jnp.zeros(shape, bool)  # flax.nn.Dropout(1.0) semantics
-    threshold = min(int(round(rate * 256.0)), 255)
+    threshold = int(round(rate * 256.0))
     if threshold <= 0:
         return jnp.ones(shape, bool)
     bits = jax.random.bits(rng, shape, jnp.uint8)
-    return bits >= jnp.uint8(threshold)
+    return bits >= jnp.uint8(min(threshold, 255))
 
 
 def dropout(
@@ -55,11 +67,13 @@ def dropout(
     rate: float,
     exact: bool = False,
 ) -> jax.Array:
-    """Inverted dropout of ``x`` (scale-at-train by 1/(1-rate))."""
+    """Inverted dropout of ``x`` (scale-at-train by the EFFECTIVE keep
+    probability, so E[output] == input on the quantized path too)."""
     if rate <= 0.0:
         return x
     keep = dropout_keep_mask(rng, x.shape, rate, exact=exact)
-    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+    eff = quantized_rate(rate, exact)
+    return jnp.where(keep, x / (1.0 - eff), 0.0).astype(x.dtype)
 
 
 class Dropout(nn.Module):
