@@ -6,10 +6,19 @@ HorovodRunner/Lightning on GPU clusters, as a single-process TPU run.
 converter layer (pass --materialize to generate a synthetic one there
 first); without it, an in-memory synthetic stream is used.
 
+L5 composition (SURVEY.md §5.3-§5.5): --checkpoint-dir saves/RESUMES
+through tpudl.checkpoint.CheckpointManager (kill the run, rerun the same
+command, training continues), --log-dir streams metrics through
+MetricLogger (JSONL + TensorBoard), and a held-out eval (last Parquet
+file, a true holdout) prints final accuracy — the reference verifies
+model outputs every run (reference notebooks/cv/onnx_experiments.py:
+98-100,178-184); so does this.
+
 Run: python notebooks/cv/train_cifar10.py [--steps N]
 """
 
 import argparse
+import itertools
 import pathlib
 import sys
 
@@ -19,13 +28,18 @@ import jax
 import jax.numpy as jnp
 
 from tpudl.config import get_config
+from tpudl.data.converter import make_converter, prefetch_to_device
+from tpudl.data.datasets import eval_stream, split_train_eval
 from tpudl.data.synthetic import synthetic_classification_batches
 from tpudl.models.registry import build_model
+from tpudl.parallel.sharding import strategy_rules
 from tpudl.runtime import make_mesh
 from tpudl.train import (
     compile_step,
     create_train_state,
+    evaluate,
     fit,
+    make_classification_eval_step,
     make_classification_train_step,
 )
 from tpudl.train.optim import make_optimizer
@@ -33,17 +47,34 @@ from tpudl.train.optim import make_optimizer
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--steps", type=int, default=200,
+                        help="total optimizer-step budget (warmup included)")
     parser.add_argument("--batch", type=int, default=None)
     parser.add_argument("--data-dir", type=str, default=None,
                         help="CIFAR-schema Parquet dataset directory")
     parser.add_argument("--materialize", action="store_true",
                         help="generate a synthetic dataset into --data-dir first")
+    parser.add_argument("--strategy", type=str, default=None,
+                        help="override config strategy: dp | fsdp")
+    parser.add_argument("--checkpoint-dir", type=str, default=None,
+                        help="CheckpointManager directory: saves every "
+                        "--checkpoint-every steps and RESUMES from the "
+                        "latest checkpoint on restart")
+    parser.add_argument("--checkpoint-every", type=int, default=50)
+    parser.add_argument("--log-dir", type=str, default=None,
+                        help="MetricLogger directory (JSONL + TensorBoard)")
+    parser.add_argument("--eval-steps", type=int, default=8,
+                        help="held-out eval batches after training (0 = off)")
     args = parser.parse_args()
     if args.materialize and not args.data_dir:
         parser.error("--materialize requires --data-dir")
 
-    cfg = get_config("cifar10_resnet18")
+    overrides = {}
+    if args.strategy:
+        overrides["strategy"] = args.strategy
+    if args.checkpoint_dir:
+        overrides["checkpoint_dir"] = args.checkpoint_dir
+    cfg = get_config("cifar10_resnet18", **overrides)
     batch_size = args.batch or cfg.global_batch_size
 
     model = build_model(cfg.model, cfg.num_classes, small_inputs=True)
@@ -54,30 +85,45 @@ def main():
         make_optimizer(cfg.optim),
     )
     mesh = make_mesh(cfg.mesh)
+    rules = strategy_rules(cfg.strategy)
     step = compile_step(
-        make_classification_train_step(cfg.label_smoothing), mesh, state, None
+        make_classification_train_step(cfg.label_smoothing), mesh, state, rules
     )
 
     warmup_steps = 2
     if args.data_dir:
         from tpudl.data.augment import BatchAugmenter
-        from tpudl.data.converter import make_converter
         from tpudl.data.datasets import materialize_cifar10_like
 
         if args.materialize:
             conv = materialize_cifar10_like(args.data_dir, num_rows=50_000)
         else:
             conv = make_converter(args.data_dir)
+        train_conv, eval_conv = split_train_eval(conv)
         # Standard CIFAR training augmentation (pad-4 random crop + flip +
         # normalize), fused in the native C++ kernel when available
         # (tpudl/native/augment.cpp; numpy fallback otherwise).
         augment = BatchAugmenter(
             crop=(cfg.image_size, cfg.image_size), pad=4, seed=cfg.seed
         )
-        raw = conv.make_batch_iterator(
+        raw = train_conv.make_batch_iterator(
             batch_size, epochs=None, shuffle=True, seed=cfg.seed,
             transform=augment,
         )
+
+        # Eval path: SAME normalization as training (CIFAR mean/std via
+        # the augmenter's eval mode), no crop/flip.
+        eval_augment = BatchAugmenter(
+            crop=(cfg.image_size, cfg.image_size), pad=0, hflip=False,
+            train=False,
+        )
+
+        def _eval_normalize(b):
+            out = eval_augment(b)
+            out["label"] = out["label"].astype("int32")
+            return out
+
+        eval_raw = eval_stream(eval_conv, batch_size, _eval_normalize)
     else:
         raw = synthetic_classification_batches(
             batch_size,
@@ -86,29 +132,90 @@ def main():
             seed=cfg.seed,
             num_batches=args.steps + warmup_steps,
         )
+
+        def eval_raw():
+            # Held-out synthetic stream: same distribution, disjoint seed.
+            return synthetic_classification_batches(
+                batch_size,
+                image_shape=(cfg.image_size, cfg.image_size, 3),
+                num_classes=cfg.num_classes,
+                seed=cfg.seed + 10_000,
+                num_batches=args.eval_steps,
+            )
+
+    # Checkpoint/resume: restore the latest state if the directory has
+    # one; fast-forward the stream so a killed run rerun with the same
+    # flags continues where it stopped.
+    ckpt_mgr = None
+    start_step = 0
+    if cfg.checkpoint_dir:
+        from tpudl.checkpoint import CheckpointManager
+        from tpudl.train import resume_latest
+
+        ckpt_mgr = CheckpointManager(cfg.checkpoint_dir)
+        state, start_step = resume_latest(ckpt_mgr, state, mesh, rules)
+        if start_step:
+            print(f"resumed from step {start_step} ({cfg.checkpoint_dir})")
+
     # Prefetch either stream: explicit placement overlaps the host->device
     # transfer with compute (jit's implicit numpy-arg transfer is
     # pathologically slow on relay-attached devices).
-    from tpudl.data.converter import prefetch_to_device
-
-    batches = prefetch_to_device(raw, mesh=mesh)
+    # Fast-forward a resumed run on the HOST side (before device
+    # prefetch) so skipped batches never pay a transfer.
+    if start_step:
+        raw = itertools.islice(iter(raw), start_step, None)
+    batches = iter(prefetch_to_device(raw, mesh=mesh))
     rng = jax.random.key(cfg.seed + 1)
+
+    logger = None
+    if args.log_dir:
+        from tpudl.train import MetricLogger
+
+        logger = MetricLogger(args.log_dir)
 
     def log(i, metrics):
         print(f"step {i}: loss {metrics['loss']:.4f} acc {metrics['accuracy']:.3f}")
+        if logger:
+            logger(start_step + i, metrics)
 
     # Warmup outside the timing window, closed by a readback (compile is
     # synchronous, but program upload + first execution on the relay-
     # attached chip is async behind the dispatch).
-    batches = iter(batches)
-    for _ in range(warmup_steps):
+    # --steps is the TOTAL optimizer-step budget (warmup included); a run
+    # resumed at or past the budget trains zero further steps.
+    budget = max(args.steps - start_step, 0)
+    wsteps = min(warmup_steps, budget)
+    remaining = budget - wsteps
+    warm = None
+    for _ in range(wsteps):
         state, warm = step(state, next(batches), rng)
-    float(warm["loss"])
+    if warm is not None:
+        float(warm["loss"])
     state, metrics, info = fit(
-        step, state, batches, rng, num_steps=args.steps,
+        step, state, itertools.islice(batches, remaining), rng,
         log_every=cfg.log_every, logger=log,
+        checkpoint_manager=ckpt_mgr,
+        checkpoint_every=args.checkpoint_every if ckpt_mgr else 0,
     )
     print(f"final: {metrics}")
+
+    if args.eval_steps:
+        eval_step = compile_step(
+            make_classification_eval_step(), mesh, state, rules, has_rng=False
+        )
+        eval_metrics = evaluate(
+            eval_step, state, eval_raw(), num_steps=args.eval_steps
+        )
+        print(
+            f"held-out eval (<= {args.eval_steps} batches): "
+            f"loss {eval_metrics['loss']:.4f} "
+            f"accuracy {eval_metrics['accuracy']:.3f}"
+        )
+        if logger:
+            logger(start_step + info["steps"],
+                   {f"eval_{k}": v for k, v in eval_metrics.items()})
+    if logger:
+        logger.close()
     print(
         f"throughput ~{batch_size * info['steps'] / info['seconds']:.0f} images/sec "
         f"over {info['steps']} steady-state steps (compile + warmup excluded)"

@@ -27,13 +27,17 @@ import jax.numpy as jnp
 
 from tpudl.config import get_config
 from tpudl.data.converter import make_converter, prefetch_to_device
+from tpudl.data.datasets import eval_stream, split_train_eval
 from tpudl.data.synthetic import synthetic_token_batches
 from tpudl.models.registry import build_model
+from tpudl.parallel.sharding import strategy_rules
 from tpudl.runtime import make_mesh
 from tpudl.train import (
     compile_step,
     create_train_state,
+    evaluate,
     fit,
+    make_classification_eval_step,
     make_classification_train_step,
 )
 from tpudl.train.metrics import (
@@ -63,17 +67,53 @@ def main():
         "vocab on it, tokenize into an ids dataset, and fine-tune on that "
         "— text -> ids -> fine-tune in one command",
     )
+    parser.add_argument("--strategy", type=str, default=None,
+                        help="override config strategy: dp | fsdp | tp | "
+                        "fsdp+tp | pp")
+    parser.add_argument("--mesh", type=str, default=None,
+                        help="dp,fsdp,sp,tp[,pp[,ep]] (e.g. 2,1,1,1,4)")
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="GPipe microbatches (strategy=pp only)")
+    parser.add_argument("--checkpoint-dir", type=str, default=None,
+                        help="CheckpointManager directory: saves every "
+                        "--checkpoint-every steps and RESUMES from the "
+                        "latest checkpoint on restart")
+    parser.add_argument("--checkpoint-every", type=int, default=50)
+    parser.add_argument("--log-dir", type=str, default=None,
+                        help="MetricLogger directory (JSONL + TensorBoard)")
+    parser.add_argument("--eval-steps", type=int, default=8,
+                        help="held-out eval batches after training (0 = off)")
     args = parser.parse_args()
     if (args.materialize or args.text_data) and not args.data_dir:
         parser.error("--materialize/--text-data require --data-dir")
 
-    cfg = get_config("sst2_bert_base")
+    overrides = {}
     if args.model:
-        cfg = get_config("sst2_bert_base", model=args.model)
+        overrides["model"] = args.model
+    if args.strategy:
+        overrides["strategy"] = args.strategy
+    if args.checkpoint_dir:
+        overrides["checkpoint_dir"] = args.checkpoint_dir
+    if args.mesh:
+        from tpudl.runtime import MeshSpec
+
+        overrides["mesh"] = MeshSpec(
+            *(int(x) for x in args.mesh.split(","))
+        )
+    cfg = get_config("sst2_bert_base", **overrides)
     batch_size = args.batch or cfg.global_batch_size
     seq_len = args.seq_len or cfg.seq_len
 
-    model = build_model(cfg.model, cfg.num_classes)
+    mesh = make_mesh(cfg.mesh)
+    if cfg.strategy == "pp":
+        from tpudl.models.registry import build_pipelined_model
+
+        model = build_pipelined_model(
+            cfg.model, cfg.num_classes,
+            num_stages=mesh.shape["pp"], num_microbatches=args.microbatches,
+        )
+    else:
+        model = build_model(cfg.model, cfg.num_classes)
     sample_ids = jnp.zeros((1, seq_len), jnp.int32)
     state = create_train_state(
         jax.random.key(cfg.seed),
@@ -85,16 +125,16 @@ def main():
         p.size for p in jax.tree_util.tree_leaves(state.params)
     )
     print(f"{cfg.model}: {num_params / 1e6:.1f}M params, batch {batch_size}, "
-          f"seq {seq_len}")
+          f"seq {seq_len}, strategy {cfg.strategy}")
 
-    mesh = make_mesh(cfg.mesh)
+    rules = strategy_rules(cfg.strategy)
     step = compile_step(
         make_classification_train_step(
             input_keys=("input_ids", "attention_mask"), label_key="label"
         ),
         mesh,
         state,
-        None,
+        rules,
     )
 
     warmup_steps = 2
@@ -138,12 +178,14 @@ def main():
             conv = tokenize_text_dataset(
                 text_dir, ids_dir, tok, seq_len=seq_len
             )
+        conv, eval_conv = split_train_eval(conv)
         raw = (
             normalize_sst2_batch(b)
             for b in conv.make_batch_iterator(
                 batch_size, epochs=None, shuffle=True, seed=cfg.seed
             )
         )
+        eval_raw = eval_stream(eval_conv, batch_size, normalize_sst2_batch)
     elif args.data_dir:
         from tpudl.data.datasets import materialize_sst2_like, normalize_sst2_batch
 
@@ -154,12 +196,14 @@ def main():
             )
         else:
             conv = make_converter(args.data_dir)
+        conv, eval_conv = split_train_eval(conv)
         raw = (
             normalize_sst2_batch(b)
             for b in conv.make_batch_iterator(
                 batch_size, epochs=None, shuffle=True, seed=cfg.seed
             )
         )
+        eval_raw = eval_stream(eval_conv, batch_size, normalize_sst2_batch)
     else:
         raw = synthetic_token_batches(
             batch_size,
@@ -169,14 +213,51 @@ def main():
             seed=cfg.seed,
             num_batches=args.steps + warmup_steps,
         )
-    # Prefetch either stream: explicit placement overlaps the host->device
-    # transfer with compute (jit's implicit numpy-arg transfer is
-    # pathologically slow on relay-attached devices).
+        # Held-out synthetic stream: same distribution, disjoint seed.
+        eval_raw = lambda: synthetic_token_batches(  # noqa: E731
+            batch_size,
+            seq_len=seq_len,
+            vocab_size=model.cfg.vocab_size,
+            num_classes=cfg.num_classes,
+            seed=cfg.seed + 10_000,
+            num_batches=args.eval_steps,
+        )
+    # Checkpoint/resume (SURVEY.md §5.3/§5.4): restore the latest state
+    # if the directory has one; fast-forward the stream so a killed run
+    # rerun with the same flags continues where it stopped.
+    ckpt_mgr = None
+    start_step = 0
+    if cfg.checkpoint_dir:
+        from tpudl.checkpoint import CheckpointManager
+        from tpudl.train import resume_latest
+
+        ckpt_mgr = CheckpointManager(cfg.checkpoint_dir)
+        state, start_step = resume_latest(ckpt_mgr, state, mesh, rules)
+        if start_step:
+            print(f"resumed from step {start_step} ({cfg.checkpoint_dir})")
+
+    # Fast-forward a resumed run on the HOST side (before device
+    # prefetch), so skipped batches never pay a transfer; then prefetch:
+    # explicit placement overlaps the host->device transfer with compute
+    # (jit's implicit numpy-arg transfer is pathologically slow on
+    # relay-attached devices).
+    import itertools
+
+    if start_step:
+        raw = itertools.islice(iter(raw), start_step, None)
     batches = prefetch_to_device(raw, mesh=mesh)
     rng = jax.random.key(cfg.seed + 1)
 
+    logger = None
+    if args.log_dir:
+        from tpudl.train import MetricLogger
+
+        logger = MetricLogger(args.log_dir)
+
     def log(i, metrics):
         print(f"step {i}: loss {metrics['loss']:.4f} acc {metrics['accuracy']:.3f}")
+        if logger:
+            logger(start_step + i, metrics)
 
     # Warmup outside the timing window, CLOSED BY A READBACK: the first
     # call pays the XLA compile synchronously, but the compiled program's
@@ -185,14 +266,47 @@ def main():
     # lands inside the timed window and deflates samples/sec and MFU
     # (the BASELINE.json metrics are steady-state quantities).
     batches = iter(batches)
-    for _ in range(warmup_steps):
+    # --steps is the TOTAL optimizer-step budget (warmup included); a run
+    # resumed at or past the budget trains zero further steps.
+    budget = max(args.steps - start_step, 0)
+    wsteps = min(warmup_steps, budget)
+    remaining = budget - wsteps
+    warm = None
+    for _ in range(wsteps):
         state, warm = step(state, next(batches), rng)
-    float(warm["loss"])
+    if warm is not None:
+        float(warm["loss"])
     state, metrics, info = fit(
-        step, state, batches, rng, num_steps=args.steps,
+        step, state, itertools.islice(batches, remaining), rng,
         log_every=cfg.log_every, logger=log,
+        checkpoint_manager=ckpt_mgr,
+        checkpoint_every=args.checkpoint_every if ckpt_mgr else 0,
     )
     print(f"final: {metrics}")
+
+    if args.eval_steps:
+        eval_step = compile_step(
+            make_classification_eval_step(
+                input_keys=("input_ids", "attention_mask"), label_key="label"
+            ),
+            mesh,
+            state,
+            rules,
+            has_rng=False,
+        )
+        eval_metrics = evaluate(
+            eval_step, state, eval_raw(), num_steps=args.eval_steps
+        )
+        print(
+            f"held-out eval (<= {args.eval_steps} batches): "
+            f"loss {eval_metrics['loss']:.4f} "
+            f"accuracy {eval_metrics['accuracy']:.3f}"
+        )
+        if logger:
+            logger(start_step + info["steps"],
+                   {f"eval_{k}": v for k, v in eval_metrics.items()})
+    if logger:
+        logger.close()
 
     samples_per_sec = batch_size * info["steps"] / info["seconds"]
     # FLOPs from the compiled executable; 6ND transformer estimate as fallback.

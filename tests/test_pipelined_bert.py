@@ -1,0 +1,195 @@
+"""Pipeline-parallel BERT training end-to-end (tpudl.parallel.pipelined_bert).
+
+The round-2 verdict's acceptance: tiny-BERT training under pp=4 must
+match pp=1 losses step for step, driven through the REAL training stack
+(create_train_state / compile_step / fit semantics), with optimizer state
+living over the stacked stage tree.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpudl.models.bert import BERT_TINY
+from tpudl.parallel.pipelined_bert import (
+    PIPELINED_BERT_RULES,
+    PipelinedBertClassifier,
+)
+from tpudl.parallel.sharding import _path_str
+from tpudl.runtime.mesh import MeshSpec, make_mesh
+from tpudl.train import (
+    compile_step,
+    create_train_state,
+    make_classification_train_step,
+)
+
+CFG = BERT_TINY(
+    num_layers=4,
+    vocab_size=256,
+    num_heads=2,
+    dtype=jnp.float32,  # isolate schedule parity from bf16 rounding
+)
+
+
+def _batches(n, batch=16, seq=16, seed=5):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(1, 256, size=(batch, seq)).astype(np.int32)
+        out.append(
+            {
+                "input_ids": ids,
+                "attention_mask": np.ones_like(ids),
+                "label": rng.integers(0, 2, size=(batch,)).astype(np.int32),
+            }
+        )
+    return out
+
+
+def _train(mesh, steps=6, cfg=None, distinct_batches=2):
+    model = PipelinedBertClassifier(
+        cfg or CFG, num_stages=4, num_microbatches=4
+    )
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, 16), jnp.int32),
+        optax.adamw(1e-3),
+    )
+    step = compile_step(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"), label_key="label"
+        ),
+        mesh,
+        state,
+        PIPELINED_BERT_RULES,
+    )
+    losses = []
+    rng = jax.random.key(1)
+    # A small cycling batch set, so "it learns" is memorization-testable.
+    pool = _batches(distinct_batches)
+    for i in range(steps):
+        state, metrics = step(state, pool[i % distinct_batches], rng)
+        losses.append(float(metrics["loss"]))
+    return losses, step, state
+
+
+NODROP = BERT_TINY(
+    num_layers=4,
+    vocab_size=256,
+    num_heads=2,
+    hidden_dropout=0.0,
+    attention_dropout=0.0,
+    dtype=jnp.float32,
+)
+
+
+def test_pp4_training_matches_pp1():
+    """Same model, same data, same rngs: losses under the pp=4 pipeline
+    equal the pp=1 sequential fold step for step (dropout off — the
+    deterministic-math acceptance; see the module docstring for why
+    dropout STREAMS legitimately differ across mesh layouts).
+
+    Tolerances: the first step is strict (identical math); the 8-device
+    meshes necessarily differ in data-parallel extent (pp=1 forces dp=8,
+    pp=4 runs dp=2), so f32 psum-order noise amplifies mildly through
+    AdamW over later steps — the trajectory bound still catches real
+    schedule bugs (those diverge O(1) immediately)."""
+    pp1_losses, _, _ = _train(
+        make_mesh(MeshSpec(dp=-1, pp=1)), steps=10, cfg=NODROP
+    )
+    pp4_losses, _, _ = _train(
+        make_mesh(MeshSpec(dp=2, fsdp=1, sp=1, tp=1, pp=4)),
+        steps=10,
+        cfg=NODROP,
+    )
+    np.testing.assert_allclose(pp4_losses[0], pp1_losses[0], rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(pp4_losses, pp1_losses, rtol=1e-3, atol=1e-3)
+    # and it actually learns (memorizes the cycling batch pool)
+    assert min(pp4_losses[-2:]) < pp4_losses[0]
+
+
+def test_pp4_trains_with_dropout():
+    """Dropout-0.1 training under pp=4: deterministic per rng, finite,
+    learning — the masks are per-(microbatch, layer) streams from the
+    hardware-bits path."""
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=1, sp=1, tp=1, pp=4))
+    losses_a, _, _ = _train(mesh, steps=12)
+    losses_b, _, _ = _train(mesh, steps=12)
+    np.testing.assert_allclose(losses_a, losses_b, rtol=0, atol=0)
+    assert np.all(np.isfinite(losses_a))
+    assert min(losses_a[-2:]) < losses_a[0]
+
+
+def test_stage_params_and_opt_state_shard_over_pp():
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=1, sp=1, tp=1, pp=4))
+    _, step, state = _train(mesh, steps=1)
+    specs = {
+        _path_str(p): str(s.spec)
+        for p, s in jax.tree_util.tree_leaves_with_path(step.state_shardings)
+    }
+    stage_param_specs = [
+        s
+        for p, s in specs.items()
+        if "stages/layers" in p and p.startswith("params/")
+    ]
+    assert stage_param_specs and all("pp" in s for s in stage_param_specs)
+    # optimizer moments over the stacked tree shard too
+    opt_specs = [
+        s
+        for p, s in specs.items()
+        if "stages/layers" in p and "opt_state" in p and "kernel" in p
+    ]
+    assert opt_specs and all("pp" in s for s in opt_specs), specs
+
+
+def test_forward_matches_sequential_layers():
+    """The pipelined forward (no mesh: degenerate fold) equals manually
+    running embeddings -> layers -> pooler -> classifier with the same
+    restructured weights."""
+    from tpudl.models.bert import BertEmbeddings, BertLayer, _dense
+    from tpudl.ops.attention import padding_mask
+
+    model = PipelinedBertClassifier(CFG, num_stages=2, num_microbatches=2)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, 256, size=(4, 16)), jnp.int32
+    )
+    variables = model.init(jax.random.key(3), ids)
+    out = model.apply(variables, ids)
+
+    p = variables["params"]
+    x = BertEmbeddings(CFG).apply(
+        {"params": p["io"]["embeddings"]}, ids, jnp.zeros_like(ids), False
+    )
+    mask4 = padding_mask(jnp.ones_like(ids))
+    layer = BertLayer(CFG)
+    stacked = p["stages"]["layers"]
+    for s in range(2):
+        for j in range(2):
+            lp = jax.tree.map(lambda a: a[s][j], stacked)
+            x = layer.apply({"params": lp}, x, mask4, False)
+    pooled = jnp.tanh(
+        _dense(CFG, CFG.hidden_size, "pooler").apply(
+            {"params": p["io"]["pooler"]}, x[:, 0]
+        )
+    )
+    expected = (
+        pooled @ p["io"]["classifier"]["kernel"]
+        + p["io"]["classifier"]["bias"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), atol=1e-5
+    )
+
+
+def test_validates_divisibility():
+    import pytest
+
+    with pytest.raises(ValueError, match="not divisible"):
+        PipelinedBertClassifier(CFG, num_stages=3, num_microbatches=2)
+    model = PipelinedBertClassifier(CFG, num_stages=2, num_microbatches=3)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(ValueError, match="num_microbatches"):
+        model.apply(variables, jnp.zeros((4, 8), jnp.int32))

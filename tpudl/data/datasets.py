@@ -260,3 +260,32 @@ def tokenize_text_dataset(
             _flush()
     _flush()
     return make_converter(out_dir)
+
+
+def split_train_eval(conv):
+    """File-level holdout shared by the training notebooks: the last
+    Parquet file is the eval split (a TRUE holdout — its rows never enter
+    the train iterator), mirroring the reference's habit of verifying
+    model outputs every run (reference
+    notebooks/cv/onnx_experiments.py:98-100,178-184). Single-file
+    datasets fall back to overlap with a warning."""
+    if len(conv.files) < 2:
+        print("WARNING: single-file dataset — eval split overlaps training")
+        return conv, conv
+    ordered = sorted(conv.files)
+    return make_converter(ordered[:-1]), make_converter(ordered[-1:])
+
+
+def eval_stream(eval_conv, batch_size: int, normalize):
+    """Re-iterable held-out batch stream (tpudl.train.evaluate drains one
+    epoch per call)."""
+
+    def gen():
+        return (
+            normalize(b)
+            for b in eval_conv.make_batch_iterator(
+                batch_size, epochs=1, shuffle=False, drop_last=True
+            )
+        )
+
+    return gen

@@ -41,3 +41,25 @@ def build_model(name: str, num_classes: int, **kwargs: Any):
 
         return build_llama(name, num_classes=num_classes, dtype=dtype, **kwargs)
     raise ValueError(f"unknown model name: {name!r}")
+
+
+def build_pipelined_model(
+    name: str,
+    num_classes: int,
+    num_stages: int,
+    num_microbatches: int,
+    **kwargs: Any,
+):
+    """Config strategy='pp' model path: a BERT size name as a
+    PipelinedBertClassifier (tpudl.parallel.pipelined_bert) whose encoder
+    stages train sharded over the pp mesh axis."""
+    dtype = kwargs.pop("dtype", jnp.bfloat16)
+    if name not in _BERT_SIZES:
+        raise ValueError(
+            f"strategy='pp' supports BERT sizes {sorted(_BERT_SIZES)}; "
+            f"got {name!r}"
+        )
+    from tpudl.parallel.pipelined_bert import PipelinedBertClassifier
+
+    cfg = _BERT_SIZES[name](num_labels=num_classes, dtype=dtype, **kwargs)
+    return PipelinedBertClassifier(cfg, num_stages, num_microbatches)

@@ -167,3 +167,27 @@ TP_TRANSFORMER_RULES: Rules = (
     (r"(embedding|word_embeddings)/embedding$", P("tp", "fsdp")),
     (r"kernel$", P("fsdp", None)),
 )
+
+
+def strategy_rules(strategy: str) -> Rules:
+    """TrainConfig.strategy -> the sharding rule set it names (the
+    round-2 'dead config field' is now load-bearing: notebooks pass
+    ``strategy_rules(cfg.strategy)`` to compile_step)."""
+    if strategy == "dp":
+        return DP_RULES
+    if strategy == "fsdp":
+        return FSDP_RULES
+    if strategy in ("tp", "fsdp+tp"):
+        return TP_TRANSFORMER_RULES
+    if strategy == "lora":
+        from tpudl.models.lora import LORA_RULES, compose_rules
+
+        return compose_rules(LORA_RULES, TP_TRANSFORMER_RULES)
+    if strategy == "pp":
+        from tpudl.parallel.pipelined_bert import PIPELINED_BERT_RULES
+
+        return PIPELINED_BERT_RULES
+    raise ValueError(
+        f"unknown strategy {strategy!r}; expected dp | fsdp | tp | "
+        f"fsdp+tp | lora | pp"
+    )
