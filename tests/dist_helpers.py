@@ -80,3 +80,65 @@ def distributed_train_smoke():
 
 def failing_worker():
     raise RuntimeError("intentional worker failure")
+
+
+def converter_fed_train(data_dir, local_batch=16):
+    """The Petastorm-contract promise, actually executed multi-process
+    (round-2 missing #4): each worker reads ITS disjoint converter shard
+    of a materialized Parquet dataset, feeds it through
+    prefetch_to_device(mesh) (jax.make_array_from_process_local_data)
+    into fit(), for exactly one epoch. Returns (losses, rows_consumed)
+    — ranks must agree on every global loss, and the rows consumed
+    across ranks must cover the dataset (minus batch truncation)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.data.converter import make_converter, prefetch_to_device
+    from tpudl.data.datasets import normalize_cifar_batch
+    from tpudl.models.resnet import ResNetTiny
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        fit,
+        make_classification_train_step,
+    )
+
+    conv = make_converter(data_dir)
+    mesh = make_mesh(MeshSpec(dp=-1))
+    model = ResNetTiny(num_classes=10)
+    state = create_train_state(
+        jax.random.key(0), model, jnp.zeros((1, 32, 32, 3)), optax.sgd(0.05)
+    )
+    step = compile_step(make_classification_train_step(), mesh, state, None)
+
+    rows = {"n": 0}
+
+    def counted():
+        for batch in conv.make_batch_iterator(
+            local_batch,
+            epochs=1,
+            shuffle=False,
+            drop_last=True,
+            shard_index=jax.process_index(),
+            num_shards=jax.process_count(),
+            transform=normalize_cifar_batch,
+        ):
+            rows["n"] += len(batch["label"])
+            yield batch
+
+    losses = []
+
+    def log(i, metrics):
+        losses.append(metrics["loss"])
+
+    state, metrics, info = fit(
+        step,
+        state,
+        prefetch_to_device(counted(), mesh=mesh),
+        jax.random.key(1),
+        log_every=1,
+        logger=log,
+    )
+    return losses, rows["n"]

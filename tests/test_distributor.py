@@ -1,6 +1,7 @@
 """TpuDistributor launch-path tests (SURVEY.md §4.2: localhost multi-process
 bring-up substitutes for the reference lineage's run-on-a-cluster testing)."""
 
+import numpy as np
 import pytest
 
 from tests import dist_helpers
@@ -54,3 +55,39 @@ def test_worker_failure_propagates():
     d = TpuDistributor(num_processes=2, platform="cpu", devices_per_process=1)
     with pytest.raises(RuntimeError, match="intentional worker failure"):
         d.run(dist_helpers.failing_worker)
+
+
+@pytest.mark.slow
+def test_spawn_converter_fed_training(tmp_path):
+    """BASELINE.json north_star composition, executed: a materialized
+    Parquet dataset feeds a 2-process x 2-device fit() run through
+    disjoint converter shards and prefetch_to_device's
+    make_array_from_process_local_data path. Every rank sees identical
+    global losses; the ranks together consume the whole dataset (minus
+    per-shard batch truncation)."""
+    from tpudl.data.datasets import materialize_cifar10_like
+
+    data_dir = str(tmp_path / "cifar")
+    # 250 rows / 2 shards / batch 16: each 125-row shard truncates its
+    # last partial batch to 112 consumed rows — real truncation, so the
+    # coverage arithmetic below actually verifies the shard contract.
+    num_rows, local_batch = 250, 16
+    conv = materialize_cifar10_like(
+        data_dir, num_rows=num_rows, rows_per_file=64
+    )
+    assert len(conv) == num_rows
+
+    d = TpuDistributor(num_processes=2, platform="cpu", devices_per_process=2)
+    results = d.run(dist_helpers.converter_fed_train, data_dir, local_batch)
+
+    (losses0, rows0), (losses1, rows1) = results
+    assert losses0, "no training steps ran"
+    # Identical global losses on every rank (the global-array contract).
+    assert losses0 == pytest.approx(losses1)
+    assert all(np.isfinite(losses0))
+    # Disjoint shards cover the dataset minus drop_last truncation only.
+    shard = num_rows // 2
+    expected_per_rank = (shard // local_batch) * local_batch
+    assert expected_per_rank < shard  # truncation genuinely exercised
+    assert rows0 == rows1 == expected_per_rank
+    assert len(losses0) == expected_per_rank // local_batch

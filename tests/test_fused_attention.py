@@ -110,11 +110,20 @@ def test_attend_dispatches_fused():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(attend(q, k, v)), atol=2e-4
     )
-    # Long-seq branch routes to the whole-attention kernel.
+    # Mid-seq branch routes to the whole-attention kernel.
     q2, k2, v2 = _qkv(10, s=384, h=2)
     got2 = attend(q2, k2, v2, implementation="fused", causal=True)
     want2 = attend(q2, k2, v2, mask=causal_mask(384, 384))
     np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), atol=2e-4)
+    # Past MAX_SEQ: flash takes over (and dropout is refused there).
+    q3, k3, v3 = _qkv(11, s=640, h=2)
+    got3 = attend(q3, k3, v3, implementation="fused")
+    np.testing.assert_allclose(
+        np.asarray(got3), np.asarray(attend(q3, k3, v3)), atol=2e-4
+    )
+    with pytest.raises(ValueError, match="flash"):
+        attend(q3, k3, v3, implementation="fused", dropout_rate=0.1,
+               dropout_rng=jax.random.key(0))
 
 
 def test_in_kernel_dropout_requires_tpu():

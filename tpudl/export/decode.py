@@ -1,0 +1,127 @@
+"""Serving export of the autoregressive decode path.
+
+The reference's core loop is export -> session -> infer (reference
+notebooks/cv/onnx_experiments.py:33-42,81: ONNX export, InferenceSession,
+session.run). Its decoder-model analog is this module: the prefill and
+single-token decode steps of tpudl.models.generate are exported as
+StableHLO artifacts with the KV cache as EXPLICIT inputs/outputs (the
+functional form a serving runtime needs — no flax mutable-state plumbing
+survives serialization), and a deserialized-artifact generation loop
+reproduces live ``generate()`` token for token
+(tests/test_decode_export.py).
+
+Artifacts:
+- prefill: (params, input_ids, attention_mask) -> (last_logits, cache)
+- decode:  (params, cache, token, position) -> (logits, new_cache)
+
+Both can carry multi-platform lowering (cpu + tpu) like the rest of
+tpudl.export — one artifact, either backend, the property the reference
+buys with ONNX.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpudl.export.export import export_stablehlo, load_exported
+
+# The functional prefill/decode contracts live with the live generation
+# loop (one definition — the exported artifacts CANNOT diverge from
+# generate()); re-exported here for the serving-side API.
+from tpudl.models.generate import decode_fn, prefill_fn  # noqa: F401
+
+
+def export_decoder(
+    model,
+    params,
+    batch_size: int,
+    prompt_len: int,
+    path_prefix: Optional[str] = None,
+    platforms: Optional[Sequence[str]] = None,
+) -> Tuple[bytes, bytes]:
+    """Export (prefill, decode) StableHLO artifacts for fixed
+    ``batch_size``/``prompt_len`` shapes (static shapes are the serving
+    contract — the KV cache is bounded by model.cfg.max_seq_len).
+
+    With ``path_prefix``, writes ``{prefix}.prefill.stablehlo`` and
+    ``{prefix}.decode.stablehlo``.
+    """
+    ids = jnp.zeros((batch_size, prompt_len), jnp.int32)
+    mask = jnp.ones((batch_size, prompt_len), jnp.int32)
+    pf = prefill_fn(model)
+    # A real (abstractly-traced) cache example for the decode export.
+    _, cache = jax.eval_shape(pf, params, ids, mask)
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
+    token = jnp.zeros((batch_size,), jnp.int32)
+    position = jnp.full((batch_size,), prompt_len, jnp.int32)
+
+    prefill_blob = export_stablehlo(
+        pf,
+        (params, ids, mask),
+        path=f"{path_prefix}.prefill.stablehlo" if path_prefix else None,
+        platforms=platforms,
+    )
+    decode_blob = export_stablehlo(
+        decode_fn(model),
+        (params, cache, token, position),
+        path=f"{path_prefix}.decode.stablehlo" if path_prefix else None,
+        platforms=platforms,
+    )
+    return prefill_blob, decode_blob
+
+
+def generate_with_exported(
+    prefill_call: Callable,
+    decode_call: Callable,
+    params,
+    input_ids: jax.Array,
+    max_new_tokens: int = 32,
+    eos_id: Optional[int] = None,
+    max_seq_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy generation driven entirely by deserialized artifacts — the
+    session.run loop of the reference, over StableHLO. Prompts must be
+    unpadded (the tpudl.models.generate cache contract). Returns
+    [B, max_new_tokens] token ids, eos-padded like generate().
+
+    ``max_seq_len`` is the exporting model's KV-cache bound
+    (model.cfg.max_seq_len) — the deserialized callables cannot see it,
+    and overflowing it would silently CLAMP cache writes to the last slot
+    (corrupted tokens, no error). Always pass it on serving paths.
+    """
+    b, s = input_ids.shape
+    if max_seq_len is not None and s + max_new_tokens > max_seq_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"exporting model's KV-cache bound max_seq_len={max_seq_len}"
+        )
+    mask = jnp.ones_like(input_ids)
+    logits, cache = prefill_call(params, input_ids, mask)
+    position = jnp.full((b,), s, jnp.int32)
+    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    done = jnp.zeros((b,), bool)
+    tokens = []
+    for i in range(max_new_tokens):
+        if eos_id is not None:
+            token = jnp.where(done, eos_id, token)
+            done = jnp.logical_or(done, token == eos_id)
+        tokens.append(token)
+        if i + 1 == max_new_tokens:
+            break
+        logits, cache = decode_call(params, cache, token, position)
+        position = position + 1
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.stack(tokens, axis=1)
+
+
+def load_decoder(
+    prefill_blob_or_path, decode_blob_or_path
+) -> Tuple[Callable, Callable]:
+    """Deserialize the (prefill, decode) artifact pair."""
+    return (
+        load_exported(prefill_blob_or_path),
+        load_exported(decode_blob_or_path),
+    )

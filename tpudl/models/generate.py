@@ -21,33 +21,55 @@ import jax
 import jax.numpy as jnp
 
 
+def prefill_fn(model):
+    """THE functional prefill contract (cache as explicit pytree I/O):
+    (params, input_ids, attention_mask) -> (last_logits, cache). One
+    definition serves both the live loop below and the serving export
+    (tpudl.export.decode) — they cannot diverge."""
+
+    def fn(params, input_ids, attention_mask):
+        positions = jnp.maximum(
+            jnp.cumsum(attention_mask, axis=-1) - 1, 0
+        ).astype(jnp.int32)
+        logits, mutated = model.apply(
+            {"params": params},
+            input_ids,
+            attention_mask,
+            decode=True,
+            positions=positions,
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return fn
+
+
+def decode_fn(model):
+    """THE functional single-token decode contract:
+    (params, cache, token, position) -> (logits, new_cache)."""
+
+    def fn(params, cache, token, position):
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            token[:, None],
+            jnp.ones_like(token)[:, None],
+            decode=True,
+            positions=position[:, None],
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return fn
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _prefill(model, params, input_ids, attention_mask):
-    positions = jnp.maximum(
-        jnp.cumsum(attention_mask, axis=-1) - 1, 0
-    ).astype(jnp.int32)
-    logits, mutated = model.apply(
-        {"params": params},
-        input_ids,
-        attention_mask,
-        decode=True,
-        positions=positions,
-        mutable=["cache"],
-    )
-    return logits[:, -1, :], mutated["cache"]
+    return prefill_fn(model)(params, input_ids, attention_mask)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
 def _decode_step(model, params, cache, token, position):
-    logits, mutated = model.apply(
-        {"params": params, "cache": cache},
-        token[:, None],
-        jnp.ones_like(token)[:, None],
-        decode=True,
-        positions=position[:, None],
-        mutable=["cache"],
-    )
-    return logits[:, -1, :], mutated["cache"]
+    return decode_fn(model)(params, cache, token, position)
 
 
 def _select(logits, rng, temperature):

@@ -199,10 +199,15 @@ def attend(
             dropout_rng=dropout_rng, dropout_exact=dropout_exact,
         )
     if implementation == "fused":
-        # Two regimes (measured, benchmarks/bert_attn_seq128.py): at short
-        # S, XLA's batched matmuls are unbeatable and only softmax+dropout
-        # is worth fusing (hybrid); at longer S the whole-attention kernel
-        # avoids the growing [S, S] HBM round trips with big-enough dots.
+        # Three regimes (measured, benchmarks/bert_attn_seq128.py +
+        # BASELINE.md): at short S, XLA's batched matmuls are unbeatable
+        # and only softmax+dropout is worth fusing (hybrid); at mid S the
+        # whole-attention kernel wins (S=256/512: 4.1/4.3 ms vs einsum's
+        # 5.0/5.5 fwd+bwd); past MAX_SEQ its one-pass backward blows VMEM
+        # and flash's streaming design takes over (dropout unsupported
+        # there — flash raises on a nonzero rate).
+        from tpudl.ops.fused_attention import MAX_SEQ, fused_attention
+
         if q.shape[1] <= 256:
             from tpudl.ops.softmax_dropout import hybrid_attention
 
@@ -210,12 +215,20 @@ def attend(
                 q, k, v, mask=mask, causal=causal,
                 dropout_rate=dropout_rate, dropout_rng=dropout_rng,
             )
-        from tpudl.ops.fused_attention import fused_attention
+        if q.shape[1] <= MAX_SEQ:
+            return fused_attention(
+                q, k, v, mask=mask, causal=causal,
+                dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+            )
+        if dropout_rate > 0.0:
+            raise ValueError(
+                f"attention dropout beyond seq {MAX_SEQ} needs the "
+                f"streaming flash kernel, which does not support it — "
+                f"set attention_dropout=0 for long-context training"
+            )
+        from tpudl.ops.flash_attention import flash_attention
 
-        return fused_attention(
-            q, k, v, mask=mask, causal=causal,
-            dropout_rate=dropout_rate, dropout_rng=dropout_rng,
-        )
+        return flash_attention(q, k, v, mask=mask, causal=causal)
     if dropout_rate > 0.0:
         raise ValueError(
             f"attention-probability dropout is not supported by the "
