@@ -92,3 +92,98 @@ def test_imagenet_like_pipeline_with_augmenter(tmp_path):
     a = next(conv.make_batch_iterator(batch_size=8, shard_index=0, num_shards=2))
     b = next(conv.make_batch_iterator(batch_size=8, shard_index=1, num_shards=2))
     assert not np.array_equal(a["image"], b["image"])
+
+
+def test_split_train_eval_multifile_holdout(tmp_path):
+    """Multi-file datasets hold out the last Parquet file; splits disjoint."""
+    from tpudl.data.datasets import split_train_eval
+    from tpudl.data.converter import make_converter, write_parquet
+
+    ids = np.arange(512, dtype=np.int64)
+    write_parquet(str(tmp_path), {"row_id": ids, "label": ids % 2},
+                  rows_per_file=128)
+    train, holdout = split_train_eval(make_converter(str(tmp_path)))
+    assert len(train.files) == 3 and len(holdout.files) == 1
+
+    def all_ids(conv):
+        out = []
+        for b in conv.make_batch_iterator(32, shuffle=False, drop_last=False,
+                                          shard_index=0, num_shards=1):
+            out.extend(b["row_id"].tolist())
+        return set(out)
+
+    tr, ev = all_ids(train), all_ids(holdout)
+    assert tr.isdisjoint(ev)
+    assert tr | ev == set(range(512))
+
+
+def test_split_train_eval_single_file_auto_splits_rows(tmp_path):
+    """A single-file dataset auto-splits rows (round-3 behavior was a
+    WARNING + overlapping train/eval — accuracy reported from that path was
+    silently train-set accuracy)."""
+    from tpudl.data.datasets import split_train_eval
+    from tpudl.data.converter import make_converter, write_parquet
+
+    ids = np.arange(200, dtype=np.int64)
+    write_parquet(str(tmp_path), {"row_id": ids}, rows_per_file=1024,
+                  row_group_size=64)
+    train, holdout = split_train_eval(make_converter(str(tmp_path)))
+    assert train.num_rows == 180 and holdout.num_rows == 20
+
+    def all_ids(conv, shards=1):
+        out = set()
+        for s in range(shards):
+            for b in conv.make_batch_iterator(
+                8, shuffle=False, drop_last=False,
+                shard_index=s, num_shards=shards,
+            ):
+                out.update(b["row_id"].tolist())
+        return out
+
+    tr, ev = all_ids(train), all_ids(holdout)
+    assert tr == set(range(180))
+    assert ev == set(range(180, 200))
+    # Row windows stay disjoint under multi-shard reads too, and
+    # steps_per_epoch reflects the window.
+    tr2, ev2 = all_ids(train, shards=2), all_ids(holdout, shards=2)
+    assert tr2.isdisjoint(ev2)
+    assert train.steps_per_epoch(8, num_shards=2) == 180 // 2 // 8
+    # Shuffled single-file split stays inside its window.
+    shuf = set()
+    for b in train.make_batch_iterator(8, shuffle=True, seed=3,
+                                       shard_index=0, num_shards=1):
+        shuf.update(b["row_id"].tolist())
+    assert shuf <= set(range(180))
+
+
+def test_split_train_eval_tiny_dataset_errors(tmp_path):
+    import pytest
+
+    from tpudl.data.datasets import split_train_eval
+    from tpudl.data.converter import make_converter, write_parquet
+
+    write_parquet(str(tmp_path), {"x": np.arange(1, dtype=np.int64)})
+    with pytest.raises(ValueError, match="cannot split"):
+        split_train_eval(make_converter(str(tmp_path)))
+
+
+def test_split_train_eval_guards_and_small_holdout(tmp_path):
+    """Review findings: re-splitting a windowed converter is rejected,
+    eval_fraction is validated, and a sub-batch holdout still yields one
+    (partial) eval batch through eval_stream."""
+    import pytest
+
+    from tpudl.data.datasets import eval_stream, split_train_eval
+    from tpudl.data.converter import make_converter, write_parquet
+
+    ids = np.arange(200, dtype=np.int64)
+    write_parquet(str(tmp_path), {"row_id": ids}, rows_per_file=1024)
+    conv = make_converter(str(tmp_path))
+    train, holdout = split_train_eval(conv)
+    with pytest.raises(ValueError, match="already-windowed"):
+        split_train_eval(holdout)
+    with pytest.raises(ValueError, match="eval_fraction"):
+        split_train_eval(conv, eval_fraction=1.0)
+    # holdout has 20 rows < batch 64: partial batch kept, not zero batches
+    batches = list(eval_stream(holdout, 64, lambda b: b)())
+    assert len(batches) == 1 and len(batches[0]["row_id"]) == 20

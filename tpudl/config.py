@@ -46,7 +46,7 @@ class TrainConfig:
     num_classes: int = 10
     precision: str = "bf16"  # bf16 | f32
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
-    strategy: str = "dp"  # dp | fsdp | fsdp+tp | lora
+    strategy: str = "dp"  # dp | fsdp | tp | fsdp+tp | lora | pp
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     num_steps: int = 200
     log_every: int = 20

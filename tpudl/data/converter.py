@@ -136,9 +136,19 @@ class Converter:
     num_rows: int
     #: Per-file row counts (same order as `files`); drives steps_per_epoch.
     files_rows: Optional[List[int]] = None
+    #: Optional per-file [start, stop) row windows (same order as `files`).
+    #: None = whole file. Lets two converters over the SAME file expose
+    #: disjoint row subsets (split_train_eval's single-file auto-split).
+    row_ranges: Optional[List[Optional[tuple]]] = None
 
     def __len__(self) -> int:
         return self.num_rows
+
+    def _file_range(self, fi: int, file_rows: int) -> tuple:
+        if self.row_ranges is None or self.row_ranges[fi] is None:
+            return (0, file_rows)
+        lo, hi = self.row_ranges[fi]
+        return (max(0, lo), min(hi, file_rows))
 
     def make_batch_iterator(
         self,
@@ -207,15 +217,27 @@ class Converter:
         cols = list(columns) if columns else None
         for fi in file_order:
             pf = pq.ParquetFile(self.files[fi])
-            quota = pf.metadata.num_rows // num_shards  # equal across shards
+            lo, hi = self._file_range(fi, pf.metadata.num_rows)
+            quota = (hi - lo) // num_shards  # equal across shards
             taken = 0
             offset = 0
             for rg in range(pf.metadata.num_row_groups):
+                m = pf.metadata.row_group(rg).num_rows
+                if offset + m <= lo or offset >= hi:
+                    # Whole group outside the row window: skip the Parquet
+                    # read entirely (the holdout of a single-file split
+                    # would otherwise decode ~the whole file per epoch).
+                    offset += m
+                    continue
                 table = pf.read_row_group(rg, columns=cols)
                 data = _decode_table(table)
-                m = len(table)
-                local = np.arange(m)
-                sel = local[(offset + local) % num_shards == shard_index]
+                # Global in-file positions of this group's rows; keep only
+                # the converter's row window, then round-robin WITHIN the
+                # window so two converters over disjoint windows of the
+                # same file stay disjoint per shard.
+                pos = offset + np.arange(m)
+                local = np.arange(m)[(pos >= lo) & (pos < hi)]
+                sel = local[(offset + local - lo) % num_shards == shard_index]
                 offset += m
                 if taken + len(sel) > quota:
                     sel = sel[: quota - taken]
@@ -286,7 +308,11 @@ class Converter:
         rows = self.files_rows
         if rows is None:
             rows = [pq.ParquetFile(f).metadata.num_rows for f in self.files]
-        return sum(n // num_shards for n in rows) // batch_size
+        windowed = [
+            self._file_range(fi, n)[1] - self._file_range(fi, n)[0]
+            for fi, n in enumerate(rows)
+        ]
+        return sum(n // num_shards for n in windowed) // batch_size
 
 
 def make_converter(source: str | Sequence[str]) -> Converter:

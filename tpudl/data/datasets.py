@@ -262,29 +262,62 @@ def tokenize_text_dataset(
     return make_converter(out_dir)
 
 
-def split_train_eval(conv):
-    """File-level holdout shared by the training notebooks: the last
-    Parquet file is the eval split (a TRUE holdout — its rows never enter
-    the train iterator), mirroring the reference's habit of verifying
-    model outputs every run (reference
-    notebooks/cv/onnx_experiments.py:98-100,178-184). Single-file
-    datasets fall back to overlap with a warning."""
-    if len(conv.files) < 2:
-        print("WARNING: single-file dataset — eval split overlaps training")
-        return conv, conv
-    ordered = sorted(conv.files)
-    return make_converter(ordered[:-1]), make_converter(ordered[-1:])
+def split_train_eval(conv, eval_fraction: float = 0.1):
+    """Holdout split shared by the training notebooks, mirroring the
+    reference's habit of verifying model outputs every run (reference
+    notebooks/cv/onnx_experiments.py:98-100,178-184). Multi-file datasets
+    hold out the last Parquet file (file granularity — ``eval_fraction``
+    does not apply there); a single-file dataset auto-splits its rows
+    (last ``eval_fraction`` of rows, min 1) via the converter's
+    row-window support — either way train and eval rows are DISJOINT
+    (asserted by tests/test_datasets.py), never the round-3 overlapping
+    fallback."""
+    from tpudl.data.converter import Converter
+
+    if conv.row_ranges is not None:
+        raise ValueError(
+            "split_train_eval on an already-windowed converter would "
+            "rebuild windows in absolute file coordinates (leaking rows "
+            "from outside the original split) — split the full dataset "
+            "once instead"
+        )
+    if not 0.0 < eval_fraction < 1.0:
+        raise ValueError(f"eval_fraction must be in (0, 1), got {eval_fraction}")
+    if len(conv.files) >= 2:
+        ordered = sorted(conv.files)
+        return make_converter(ordered[:-1]), make_converter(ordered[-1:])
+    n = conv.num_rows
+    if n < 2:
+        raise ValueError(
+            f"cannot split a {n}-row dataset into train and eval"
+        )
+    cut = n - max(1, int(n * eval_fraction))
+    train = Converter(
+        files=conv.files, num_rows=cut, files_rows=conv.files_rows,
+        row_ranges=[(0, cut)],
+    )
+    holdout = Converter(
+        files=conv.files, num_rows=n - cut, files_rows=conv.files_rows,
+        row_ranges=[(cut, n)],
+    )
+    return train, holdout
 
 
 def eval_stream(eval_conv, batch_size: int, normalize):
     """Re-iterable held-out batch stream (tpudl.train.evaluate drains one
-    epoch per call)."""
+    epoch per call). A holdout smaller than one batch PER SHARD keeps its
+    partial batch (drop_last=False) so evaluate() sees at least one batch
+    instead of raising — fine single-process; on a sharded mesh size such
+    holdouts to the batch axes."""
+    import jax
+
+    drop_last = len(eval_conv) // jax.process_count() >= batch_size
 
     def gen():
         return (
             normalize(b)
             for b in eval_conv.make_batch_iterator(
-                batch_size, epochs=1, shuffle=False, drop_last=True
+                batch_size, epochs=1, shuffle=False, drop_last=drop_last
             )
         )
 
