@@ -1,11 +1,17 @@
 """BERT-large (BASELINE.json configs[3] model) single-chip training step.
 
 configs[3] targets v4-32; this measures the per-chip building block on the
-one local chip — remat trades recompute for HBM so the 340M-param model
-trains at batch sizes a 16G chip could not otherwise hold.
+one local chip. Levers swept here (BASELINE.md holds the banked results):
+remat scope (none / whole-layer / attention-only / layer+dots_saveable
+policy), attention implementation, and gradient accumulation (the knob
+that realizes batch >=128 on a 16G chip where the monolithic step OOMs).
 
-Usage: python benchmarks/bert_large_single_chip.py <batch>[,batch...] [--no-remat]
+Usage:
+  python benchmarks/bert_large_single_chip.py <batch>[,batch...]
+      [--remat none|layer|attention|dots] [--attn reference|fused]
+      [--accum N] [--steps N]
 """
+import argparse
 import pathlib
 import sys
 
@@ -28,31 +34,52 @@ from tpudl.train.metrics import device_peak_flops, mfu, transformer_train_flops
 
 use_hardware_rng()
 SEQ = 128
-remat = "--no-remat" not in sys.argv
-batches = [int(x) for x in sys.argv[1].split(",")]
+
+parser = argparse.ArgumentParser()
+parser.add_argument("batches", type=str, help="comma-separated batch sizes")
+parser.add_argument("--remat", default="none",
+                    choices=["none", "layer", "attention", "dots"])
+parser.add_argument("--attn", default="reference",
+                    choices=["reference", "fused"])
+parser.add_argument("--accum", type=int, default=1)
+parser.add_argument("--steps", type=int, default=20)
+args = parser.parse_args()
+
+from tpudl.models.bert import remat_options  # noqa: E402
 
 mesh = make_mesh(MeshSpec(dp=-1))
-cfg = BERT_LARGE(remat=remat)
+cfg = BERT_LARGE(attention_impl=args.attn, **remat_options(args.remat))
 model = BertForSequenceClassification(cfg)
-state0 = create_train_state(
-    jax.random.key(0),
-    model,
-    jnp.zeros((1, SEQ), jnp.int32),
-    optax.adamw(2e-5, weight_decay=0.01),
-)
-n_params = sum(p.size for p in jax.tree.leaves(state0.params))
-print(f"BERT-large: {n_params / 1e6:.0f}M params, remat={remat}")
 
-for b in batches:
-    state = state0
+
+def fresh_state():
+    # Rebuilt per batch config: the step donates the state's buffers
+    # (matching real training — a second live state copy was costing
+    # 3.3 GB of the 16 G HBM in the round-3 version of this benchmark).
+    return create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, SEQ), jnp.int32),
+        optax.adamw(2e-5, weight_decay=0.01, mu_dtype=jnp.bfloat16),
+    )
+
+
+state = fresh_state()
+n_params = sum(p.size for p in jax.tree.leaves(state.params))
+print(f"BERT-large: {n_params / 1e6:.0f}M params, remat={args.remat}, "
+      f"attn={args.attn}, accum={args.accum}")
+
+for b in [int(x) for x in args.batches.split(",")]:
+    if state is None:
+        state = fresh_state()
     step = compile_step(
         make_classification_train_step(
-            input_keys=("input_ids", "attention_mask"), label_key="label"
+            input_keys=("input_ids", "attention_mask"), label_key="label",
+            accum_steps=args.accum,
         ),
         mesh,
         state,
         None,
-        donate_state=False,
     )
     batch = jax.device_put(
         next(synthetic_token_batches(b, seq_len=SEQ, vocab_size=30_522))
@@ -64,11 +91,10 @@ for b in batches:
             state, m = step(state, batch, rng)
         float(m["loss"])
         t0 = time.perf_counter()
-        N = 20
-        for _ in range(N):
+        for _ in range(args.steps):
             state, m = step(state, batch, rng)
         float(m["loss"])
-        dt = (time.perf_counter() - t0) / N
+        dt = (time.perf_counter() - t0) / args.steps
         print(
             f"batch={b:4d}: {b / dt:7.1f} samples/s  step {dt * 1e3:7.2f}ms  "
             f"MFU(6ND) {100 * mfu(flops, dt, 1, device_peak_flops()):.1f}%",
@@ -76,3 +102,4 @@ for b in batches:
         )
     except Exception as e:
         print(f"batch={b:4d}: FAILED {type(e).__name__}: {str(e)[:100]}")
+    state = None  # donated buffers are dead; next config rebuilds

@@ -50,6 +50,10 @@ class TrainConfig:
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     num_steps: int = 200
     log_every: int = 20
+    #: Gradient-accumulation microbatches per optimizer step (>1 = the
+    #: compiled step scans over microbatches — how a declared global batch
+    #: larger than the mesh's memory fits; tpudl.train.loop.microbatch).
+    accum_steps: int = 1
     label_smoothing: float = 0.0
     data_dir: Optional[str] = None  # parquet dir; None -> synthetic
     checkpoint_dir: Optional[str] = None
@@ -97,6 +101,10 @@ CONFIGS = {
                           total_steps=56300, weight_decay=1e-4),
         num_steps=56300,
         label_smoothing=0.1,
+        # Declared global batch 1024 via 128-row microbatches — the
+        # measured-good single-chip ResNet-50 batch (BASELINE.md); on a
+        # real v4-8 the same config runs accumulated per-chip too.
+        accum_steps=8,
     ),
     # configs[3]: BERT-large fine-tune, v4-32 (Horovod -> TpuDistributor migration).
     "bert_large_v4_32": TrainConfig(
@@ -112,6 +120,11 @@ CONFIGS = {
                           mu_dtype="bfloat16",
                           total_steps=5000, weight_decay=0.01),
         num_steps=5000,
+        # Global batch 256 as 4x64 microbatches: the single-chip step OOMs
+        # monolithic at batch >=96; accumulated it runs at 74.0% MFU
+        # (BASELINE.md). Meshes with more batch shards just split each
+        # microbatch further.
+        accum_steps=4,
     ),
     # configs[4]: Llama-3-8B LoRA (stretch — FSDP->GSPMD on v5p-64).
     "llama3_8b_lora": TrainConfig(

@@ -54,7 +54,18 @@ class BertConfig:
     num_labels: int = 2
     dtype: Any = jnp.bfloat16
     attention_impl: str = "reference"
-    remat: bool = False
+    #: Rematerialization scope: False/"none" = store all activations;
+    #: True/"layer" = recompute the whole encoder layer in the backward
+    #: (max memory saving, measured WORSE on the single-chip BERT-large
+    #: step: 43.0% vs 46.5% MFU — BASELINE.md); "attention" = recompute
+    #: only the self-attention block (drops the S x S probability tensors,
+    #: the dominant per-layer activation at large batch, while keeping the
+    #:  cheap-to-store/expensive-to-recompute matmul outputs).
+    remat: Any = False
+    #: jax.checkpoint policy name for "layer" remat — "dots_saveable"
+    #: keeps MXU outputs and recomputes only elementwise/softmax work,
+    #: a middle ground between full remat and none. None = save nothing.
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -133,13 +144,39 @@ class BertSelfAttention(nn.Module):
         return out
 
 
+def _remat_policy(name: Optional[str]):
+    if name is None:
+        return None
+    return getattr(jax.checkpoint_policies, name)
+
+
+def remat_options(cli_name: str) -> dict:
+    """CLI remat mode name -> BertConfig kwargs — the ONE mapping shared
+    by the training driver (notebooks/nlp/train_sst2.py --remat) and the
+    benchmark (benchmarks/bert_large_single_chip.py)."""
+    opts = {
+        "none": {"remat": False},
+        "layer": {"remat": "layer"},
+        "attention": {"remat": "attention"},
+        "dots": {"remat": "layer", "remat_policy": "dots_saveable"},
+    }
+    if cli_name not in opts:
+        raise ValueError(
+            f"remat mode must be one of {sorted(opts)}, got {cli_name!r}"
+        )
+    return dict(opts[cli_name])
+
+
 class BertLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
     def __call__(self, hidden, attn_mask, train: bool):
         cfg = self.cfg
-        attn_out = BertSelfAttention(cfg, name="attention")(
+        attn_cls = BertSelfAttention
+        if cfg.remat == "attention":
+            attn_cls = nn.remat(BertSelfAttention, static_argnums=(3,))
+        attn_out = attn_cls(cfg, name="attention")(
             hidden, attn_mask, train
         )
         hidden = nn.LayerNorm(
@@ -163,8 +200,12 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, hidden, attn_mask, train: bool):
         layer_cls = BertLayer
-        if self.cfg.remat:
-            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        if self.cfg.remat in (True, "layer"):
+            layer_cls = nn.remat(
+                BertLayer,
+                static_argnums=(3,),
+                policy=_remat_policy(self.cfg.remat_policy),
+            )
         for i in range(self.cfg.num_layers):
             hidden = layer_cls(self.cfg, name=f"layer_{i}")(
                 hidden, attn_mask, train

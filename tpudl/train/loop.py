@@ -20,8 +20,56 @@ import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from tpudl.parallel.sharding import Rules, active_mesh, tree_shardings
+from tpudl.parallel.sharding import (
+    Rules,
+    active_mesh,
+    constrain,
+    current_mesh,
+    tree_shardings,
+)
 from tpudl.runtime.mesh import batch_partition_spec
+
+
+def microbatch(batch: dict, accum_steps: int) -> dict:
+    """Split [B, ...] batch columns into [A, B/A, ...] microbatches for
+    gradient accumulation, communication-free under the (dp, fsdp) batch
+    sharding.
+
+    A naive ``x.reshape(A, B/A)`` makes microbatch 0 the first B/A GLOBAL
+    rows — which live on the first A⁻¹ fraction of devices — so GSPMD must
+    all-to-all every step. Gradient averaging is permutation-invariant, so
+    we instead pick the assignment where microbatch ``a`` takes a
+    contiguous slice of each device's LOCAL rows: factor the batch through
+    the shard grid ([nb, A, B/(nb·A)]), swap the loop axis out front, and
+    merge back. Every reshape/transpose factors through the sharded
+    dimension, so XLA compiles it to local moves.
+
+    Called at trace time inside a compile_step-wrapped step (the active
+    mesh supplies the batch-shard count); outside any mesh nb=1 and the
+    plain reshape is already local.
+    """
+    mesh = current_mesh()
+    nb = 1
+    if mesh is not None:
+        for ax in ("dp", "fsdp"):
+            if ax in mesh.shape:
+                nb *= mesh.shape[ax]
+
+    def one(x):
+        b = x.shape[0]
+        if b % (nb * accum_steps):
+            raise ValueError(
+                f"batch {b} not divisible by accum_steps {accum_steps} x "
+                f"batch shards {nb}"
+            )
+        xb = x.reshape(nb, accum_steps, b // (nb * accum_steps), *x.shape[1:])
+        xb = constrain(xb, ("dp", "fsdp"))
+        xb = jnp.swapaxes(xb, 0, 1)
+        xb = constrain(xb, None, ("dp", "fsdp"))
+        xb = xb.reshape(accum_steps, b // accum_steps, *x.shape[1:])
+        return constrain(xb, None, ("dp", "fsdp"))
+
+    return {k: one(v) for k, v in batch.items()}
 
 
 class TrainState(train_state.TrainState):
@@ -65,6 +113,7 @@ def make_classification_train_step(
     input_keys: "str | tuple" = ("image",),
     label_key: str = "label",
     moe_aux_weight: float = 0.0,
+    accum_steps: int = 1,
 ) -> Callable:
     """Train step for image/sequence classification models.
 
@@ -79,9 +128,22 @@ def make_classification_train_step(
     ``moe_aux_weight`` > 0 adds the MoE load-balance losses the model's
     MoE layers sowed as ``moe_aux_loss`` (tpudl.ops.moe.MoEMlp) into the
     objective, and reports their sum as the ``moe_aux`` metric.
+
+    ``accum_steps`` > 1 enables gradient accumulation: the batch splits
+    into that many microbatches (communication-free — see ``microbatch``),
+    a lax.scan computes and averages their gradients, and the optimizer
+    applies ONCE — peak activation memory drops by the factor while the
+    optimizer sees the full global batch (how configs[2]'s batch 1024 and
+    BERT-large batch >=128 fit small meshes; BASELINE.json configs[2]/[3]).
+    Exactly equal to the monolithic step for models whose loss is a mean
+    over examples (tests/test_accumulation.py asserts parity at f32);
+    BatchNorm models update their running stats per microbatch
+    sequentially, matching the smaller per-microbatch statistics.
     """
     if isinstance(input_keys, str):
         input_keys = (input_keys,)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def _sown_aux(mutated: dict) -> jax.Array:
         """Sum only the sown ``moe_aux_loss`` entries (other intermediates
@@ -94,15 +156,16 @@ def make_classification_train_step(
                 total = total + jnp.sum(leaf)
         return total
 
-    def step(state: TrainState, batch: dict, rng: jax.Array):
-        step_rng = jax.random.fold_in(rng, state.step)
+    def _grads_and_metrics(state, params, stats, batch, dropout_rng):
+        """value_and_grad of one (micro)batch; returns (grads, metrics,
+        new_stats) with metrics as means over the (micro)batch."""
         inputs = tuple(batch[k] for k in input_keys)
 
         def loss_fn(params):
             variables = {"params": params}
             mutable = []
-            if state.batch_stats is not None:
-                variables["batch_stats"] = state.batch_stats
+            if stats is not None:
+                variables["batch_stats"] = stats
                 mutable.append("batch_stats")
             if moe_aux_weight > 0.0:
                 mutable.append("intermediates")
@@ -112,12 +175,13 @@ def make_classification_train_step(
                     *inputs,
                     train=True,
                     mutable=mutable,
-                    rngs={"dropout": step_rng},
+                    rngs={"dropout": dropout_rng},
                 )
                 new_stats = mutated.get("batch_stats")
             else:
                 outputs = state.apply_fn(
-                    variables, *inputs, train=True, rngs={"dropout": step_rng}
+                    variables, *inputs, train=True,
+                    rngs={"dropout": dropout_rng},
                 )
                 mutated = {}
                 new_stats = None
@@ -130,16 +194,66 @@ def make_classification_train_step(
 
         (loss, (logits, new_stats, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
-        )(state.params)
-        new_state = state.apply_gradients(grads=grads)
-        if new_stats is not None:
-            new_state = new_state.replace(batch_stats=new_stats)
+        )(params)
         metrics = {
             "loss": loss,
             "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch[label_key]),
         }
         if aux is not None:
             metrics["moe_aux"] = aux
+        return grads, metrics, new_stats
+
+    def step(state: TrainState, batch: dict, rng: jax.Array):
+        step_rng = jax.random.fold_in(rng, state.step)
+        if accum_steps == 1:
+            grads, metrics, new_stats = _grads_and_metrics(
+                state, state.params, state.batch_stats, batch, step_rng
+            )
+        else:
+            micro = microbatch(batch, accum_steps)
+
+            def body(carry, xs):
+                grads_acc, stats, metrics_acc = carry
+                mb, a = xs
+                grads, metrics, new_stats = _grads_and_metrics(
+                    state, state.params, stats,
+                    mb, jax.random.fold_in(step_rng, a),
+                )
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                metrics_acc = jax.tree.map(jnp.add, metrics_acc, metrics)
+                return (grads_acc, new_stats, metrics_acc), None
+
+            # All microbatches run inside the one scan (a single copy of
+            # the layer graph in the executable — unrolling microbatch 0
+            # to learn the carry structure would double it); the metrics
+            # tree structure comes from eval_shape, which traces without
+            # executing. BatchNorm stats thread through the carry,
+            # updating per microbatch sequentially.
+            mb0 = {k: v[0] for k, v in micro.items()}
+            _, m_shape, _ = jax.eval_shape(
+                lambda s, b, r: _grads_and_metrics(
+                    state, state.params, s, b, r
+                ),
+                state.batch_stats, mb0, step_rng,
+            )
+            carry0 = (
+                jax.tree.map(jnp.zeros_like, state.params),
+                state.batch_stats,
+                jax.tree.map(
+                    lambda sh: jnp.zeros(sh.shape, sh.dtype), m_shape
+                ),
+            )
+            (grads, new_stats, metrics), _ = jax.lax.scan(
+                body, carry0, (micro, jnp.arange(accum_steps))
+            )
+            # Equal-sized microbatches: mean of per-microbatch means is
+            # the global mean — both grads (linear in the loss mean) and
+            # metrics divide by the microbatch count.
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
+        new_state = state.apply_gradients(grads=grads)
+        if new_stats is not None:
+            new_state = new_state.replace(batch_stats=new_stats)
         return new_state, metrics
 
     return step
