@@ -1,24 +1,36 @@
-"""ResNet-18 / CIFAR-10 training smoke (BASELINE.json configs[0]).
+"""CV training workloads (BASELINE.json configs[0] and configs[2]).
 
-The CV training workload the reference lineage runs through
-HorovodRunner/Lightning on GPU clusters, as a single-process TPU run.
---data-dir points at a CIFAR-schema Parquet dataset fed through the
-converter layer (pass --materialize to generate a synthetic one there
-first); without it, an in-memory synthetic stream is used.
+The CV training the reference lineage runs through HorovodRunner/Lightning
+on GPU clusters, as config-driven TPU runs:
+
+  python notebooks/cv/train_cifar10.py                                # configs[0]
+  python notebooks/cv/train_cifar10.py --config imagenet_resnet50_dp  # configs[2]
+
+--config selects the BASELINE.json entry: model, dataset schema +
+materializer, mesh, strategy, optimizer, label smoothing, and gradient
+accumulation all come from tpudl.config. The declared mesh auto-clamps to
+the local device count (MeshSpec.fit), so the same command drives one
+chip or a pod slice. configs[2]'s declared global batch 1024 is realized
+on a single 16G chip via accum_steps (microbatches scanned inside the
+compiled step — tpudl.train.loop.microbatch).
+
+--data-dir points at a Parquet dataset in the config's schema, fed
+through the converter layer (pass --materialize to generate a synthetic
+one there first); without it, an in-memory synthetic stream is used.
 
 L5 composition (SURVEY.md §5.3-§5.5): --checkpoint-dir saves/RESUMES
 through tpudl.checkpoint.CheckpointManager (kill the run, rerun the same
 command, training continues), --log-dir streams metrics through
-MetricLogger (JSONL + TensorBoard), and a held-out eval (last Parquet
-file, a true holdout) prints final accuracy — the reference verifies
-model outputs every run (reference notebooks/cv/onnx_experiments.py:
-98-100,178-184); so does this.
-
-Run: python notebooks/cv/train_cifar10.py [--steps N]
+MetricLogger (JSONL + TensorBoard), and a held-out eval (true holdout —
+last Parquet file, or the last rows of a single-file dataset) prints
+final accuracy — the reference verifies model outputs every run
+(reference notebooks/cv/onnx_experiments.py:98-100,178-184); so does
+this.
 """
 
 import argparse
 import itertools
+import os
 import pathlib
 import sys
 
@@ -33,7 +45,7 @@ from tpudl.data.datasets import eval_stream, split_train_eval
 from tpudl.data.synthetic import synthetic_classification_batches
 from tpudl.models.registry import build_model
 from tpudl.parallel.sharding import strategy_rules
-from tpudl.runtime import make_mesh
+from tpudl.runtime import apply_platform_env, make_mesh
 from tpudl.train import (
     compile_step,
     create_train_state,
@@ -42,18 +54,37 @@ from tpudl.train import (
     make_classification_eval_step,
     make_classification_train_step,
 )
+from tpudl.train.metrics import compiled_flops, device_peak_flops, mfu
 from tpudl.train.optim import make_optimizer
+
+apply_platform_env()
+
+#: CV configs this driver accepts, with their dataset materializers.
+CV_CONFIGS = ("cifar10_resnet18", "imagenet_resnet50_dp")
 
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default="cifar10_resnet18",
+                        choices=CV_CONFIGS,
+                        help="BASELINE.json config to drive")
     parser.add_argument("--steps", type=int, default=200,
                         help="total optimizer-step budget (warmup included)")
     parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--accum", type=int, default=None,
+                        help="gradient-accumulation microbatches "
+                        "(default: config accum_steps)")
     parser.add_argument("--data-dir", type=str, default=None,
-                        help="CIFAR-schema Parquet dataset directory")
+                        help="Parquet dataset directory (config schema)")
     parser.add_argument("--materialize", action="store_true",
                         help="generate a synthetic dataset into --data-dir first")
+    parser.add_argument("--ingest", type=str, default=None,
+                        help="REAL CIFAR-10 python archive "
+                        "(cifar-10-python.tar.gz or its extracted "
+                        "directory): ingested into --data-dir Parquet "
+                        "before training (tpudl.data.ingest)")
+    parser.add_argument("--rows", type=int, default=None,
+                        help="rows to materialize (default: dataset-specific)")
     parser.add_argument("--strategy", type=str, default=None,
                         help="override config strategy: dp | fsdp")
     parser.add_argument("--checkpoint-dir", type=str, default=None,
@@ -66,56 +97,94 @@ def main():
     parser.add_argument("--eval-steps", type=int, default=8,
                         help="held-out eval batches after training (0 = off)")
     args = parser.parse_args()
-    if args.materialize and not args.data_dir:
-        parser.error("--materialize requires --data-dir")
+    if (args.materialize or args.ingest) and not args.data_dir:
+        parser.error("--materialize/--ingest require --data-dir")
+    if args.ingest and not os.path.exists(args.ingest):
+        parser.error(f"--ingest path does not exist: {args.ingest}")
 
     overrides = {}
     if args.strategy:
         overrides["strategy"] = args.strategy
     if args.checkpoint_dir:
         overrides["checkpoint_dir"] = args.checkpoint_dir
-    cfg = get_config("cifar10_resnet18", **overrides)
+    cfg = get_config(args.config, **overrides)
     batch_size = args.batch or cfg.global_batch_size
+    accum = args.accum if args.accum is not None else cfg.accum_steps
+    is_cifar = cfg.dataset == "cifar10"
 
-    model = build_model(cfg.model, cfg.num_classes, small_inputs=True)
+    mesh_spec = cfg.mesh.fit(jax.device_count())
+    mesh = make_mesh(mesh_spec)
+    model = build_model(cfg.model, cfg.num_classes, small_inputs=is_cifar)
     state = create_train_state(
         jax.random.key(cfg.seed),
         model,
         jnp.zeros((1, cfg.image_size, cfg.image_size, 3)),
         make_optimizer(cfg.optim),
     )
-    mesh = make_mesh(cfg.mesh)
+    num_params = sum(p.size for p in jax.tree.leaves(state.params))
+    print(f"{cfg.name}: {cfg.model} {num_params / 1e6:.1f}M params, "
+          f"batch {batch_size} (accum {accum}), image {cfg.image_size}, "
+          f"strategy {cfg.strategy}, mesh {dict(mesh.shape)}")
     rules = strategy_rules(cfg.strategy)
     step = compile_step(
-        make_classification_train_step(cfg.label_smoothing), mesh, state, rules
+        make_classification_train_step(
+            cfg.label_smoothing, accum_steps=accum
+        ),
+        mesh, state, rules,
     )
 
     warmup_steps = 2
     if args.data_dir:
-        from tpudl.data.augment import BatchAugmenter
-        from tpudl.data.datasets import materialize_cifar10_like
+        from tpudl.data.augment import (
+            IMAGENET_MEAN,
+            IMAGENET_STD,
+            BatchAugmenter,
+        )
+        from tpudl.data.datasets import (
+            materialize_cifar10_like,
+            materialize_imagenet_like,
+        )
 
-        if args.materialize:
-            conv = materialize_cifar10_like(args.data_dir, num_rows=50_000)
+        if args.ingest:
+            from tpudl.data.ingest import ingest_cifar10
+
+            if not is_cifar:
+                parser.error("--ingest supports the CIFAR-10 archive format")
+            conv = ingest_cifar10(args.ingest, args.data_dir)
+            print(f"ingested {args.ingest} -> {args.data_dir} "
+                  f"({conv.num_rows} rows)")
+        elif args.materialize:
+            if is_cifar:
+                conv = materialize_cifar10_like(
+                    args.data_dir, num_rows=args.rows or 50_000
+                )
+            else:
+                conv = materialize_imagenet_like(
+                    args.data_dir, num_rows=args.rows or 8_192,
+                    image_size=cfg.image_size, num_classes=cfg.num_classes,
+                )
         else:
             conv = make_converter(args.data_dir)
         train_conv, eval_conv = split_train_eval(conv)
-        # Standard CIFAR training augmentation (pad-4 random crop + flip +
+        # Standard training augmentation (pad+random crop + flip +
         # normalize), fused in the native C++ kernel when available
         # (tpudl/native/augment.cpp; numpy fallback otherwise).
+        norm = {} if is_cifar else {
+            "mean": IMAGENET_MEAN, "std": IMAGENET_STD
+        }
         augment = BatchAugmenter(
-            crop=(cfg.image_size, cfg.image_size), pad=4, seed=cfg.seed
+            crop=(cfg.image_size, cfg.image_size),
+            pad=4 if is_cifar else 8, seed=cfg.seed, **norm,
         )
         raw = train_conv.make_batch_iterator(
             batch_size, epochs=None, shuffle=True, seed=cfg.seed,
             transform=augment,
         )
 
-        # Eval path: SAME normalization as training (CIFAR mean/std via
-        # the augmenter's eval mode), no crop/flip.
+        # Eval path: SAME normalization as training, no crop/flip.
         eval_augment = BatchAugmenter(
             crop=(cfg.image_size, cfg.image_size), pad=0, hflip=False,
-            train=False,
+            train=False, **norm,
         )
 
         def _eval_normalize(b):
@@ -123,7 +192,10 @@ def main():
             out["label"] = out["label"].astype("int32")
             return out
 
-        eval_raw = eval_stream(eval_conv, batch_size, _eval_normalize)
+        eval_raw = eval_stream(
+            eval_conv, batch_size, _eval_normalize,
+            batch_divisor=mesh.shape["dp"] * mesh.shape["fsdp"],
+        )
     else:
         raw = synthetic_classification_batches(
             batch_size,
@@ -216,10 +288,27 @@ def main():
                    {f"eval_{k}": v for k, v in eval_metrics.items()})
     if logger:
         logger.close()
-    print(
-        f"throughput ~{batch_size * info['steps'] / info['seconds']:.0f} images/sec "
-        f"over {info['steps']} steady-state steps (compile + warmup excluded)"
+    images_per_sec = batch_size * info["steps"] / max(info["seconds"], 1e-9)
+    line = (
+        f"throughput ~{images_per_sec:.0f} images/sec over {info['steps']} "
+        f"steady-state steps (compile + warmup excluded)"
     )
+    # MFU from the compiled executable's FLOPs (SURVEY.md §5.5).
+    try:
+        example = next(synthetic_classification_batches(
+            batch_size, image_shape=(cfg.image_size, cfg.image_size, 3),
+            num_classes=cfg.num_classes, num_batches=1,
+        ))
+        flops = compiled_flops(step.jitted.lower(state, example, rng))
+        if flops:
+            step_seconds = info["seconds"] / max(info["steps"], 1)
+            line += (
+                f"; MFU ~{100 * mfu(flops, step_seconds, jax.device_count()):.1f}%"
+                f" (peak {device_peak_flops() / 1e12:.0f} TFLOP/s/chip)"
+            )
+    except Exception:
+        pass
+    print(line)
 
 
 if __name__ == "__main__":

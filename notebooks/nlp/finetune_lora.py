@@ -9,6 +9,9 @@ memory win that fits 8B), sharded by composed LORA+TP+FSDP rules over the
 
 Defaults run the tiny model so the script executes anywhere (including
 the 8-device fake CPU mesh); pass --model llama3-8b-lora on a pod slice.
+--text-data runs the Llama-family raw-text vertical: text corpus ->
+first-party byte-level BPE (tpudl.data.bpe) -> ids Parquet -> LoRA
+fine-tune, in one command (--ingest points it at a real GLUE SST-2 TSV).
 
 Run: python notebooks/nlp/finetune_lora.py [--steps N] [--model llama-tiny-lora]
 """
@@ -33,7 +36,7 @@ from tpudl.models.lora import (
 )
 from tpudl.models.registry import build_model
 from tpudl.parallel.sharding import TP_TRANSFORMER_RULES
-from tpudl.runtime import MeshSpec, make_mesh
+from tpudl.runtime import MeshSpec, apply_platform_env, make_mesh
 from tpudl.train import (
     MetricLogger,
     TrainState,
@@ -42,6 +45,8 @@ from tpudl.train import (
     make_classification_train_step,
 )
 from tpudl.train.optim import make_optimizer
+
+apply_platform_env()
 
 
 def main():
@@ -53,6 +58,22 @@ def main():
     parser.add_argument("--mesh", type=str, default=None,
                         help="dp,fsdp,sp,tp (e.g. 2,2,1,2); default all-dp")
     parser.add_argument("--log-dir", type=str, default=None)
+    parser.add_argument("--data-dir", type=str, default=None,
+                        help="dataset directory (required for --text-data)")
+    parser.add_argument(
+        "--text-data", action="store_true",
+        help="raw-text vertical, Llama-style: materialize (or --ingest) a "
+        "TEXT-schema dataset under --data-dir, train a first-party "
+        "byte-level BPE vocab on it (tpudl.data.bpe), tokenize into an "
+        "ids dataset, and LoRA-fine-tune on that — text -> BPE ids -> "
+        "fine-tune in one command",
+    )
+    parser.add_argument("--ingest", type=str, default=None,
+                        help="REAL GLUE SST-2 TSV (train.tsv or the SST-2 "
+                        "directory) as the raw-text source")
+    parser.add_argument("--materialize", action="store_true",
+                        help="force re-materialization/re-tokenization of "
+                        "--data-dir")
     parser.add_argument(
         "--hf-checkpoint", type=str, default=None,
         help="local HuggingFace Llama checkpoint directory: base weights "
@@ -61,6 +82,10 @@ def main():
         "the classifier head keep their fresh init",
     )
     args = parser.parse_args()
+    if args.text_data and not args.data_dir:
+        parser.error("--text-data requires --data-dir")
+    if args.ingest and not args.text_data:
+        parser.error("--ingest feeds the raw-text vertical: add --text-data")
 
     cfg = get_config("llama3_8b_lora", model=args.model)
     model = build_model(cfg.model, cfg.num_classes, dtype=jnp.float32)
@@ -103,14 +128,65 @@ def main():
     )
 
     warmup = min(2, args.steps)
-    batches = synthetic_token_batches(
-        args.batch,
-        seq_len=args.seq_len,
-        vocab_size=model.cfg.vocab_size,
-        num_classes=cfg.num_classes,
-        seed=cfg.seed,
-        num_batches=args.steps + warmup,
-    )
+    if args.text_data:
+        import os
+
+        from tpudl.data.bpe import ByteBPETokenizer, train_bpe
+        from tpudl.data.converter import make_converter as _mk
+        from tpudl.data.datasets import (
+            materialize_sst2_text,
+            normalize_sst2_batch,
+            tokenize_text_dataset,
+        )
+
+        text_dir = os.path.join(args.data_dir, "text")
+        ids_dir = os.path.join(args.data_dir, "ids")
+        bpe_dir = os.path.join(args.data_dir, "bpe")
+        if os.path.isdir(ids_dir) and not (args.materialize or args.ingest):
+            # Petastorm contract: materialize once, train many.
+            print(f"reusing tokenized dataset {ids_dir} (BPE {bpe_dir})")
+            conv = _mk(ids_dir)
+        else:
+            if args.ingest:
+                from tpudl.data.ingest import ingest_sst2_tsv
+
+                text_conv = ingest_sst2_tsv(args.ingest, text_dir)
+                print(f"ingested {args.ingest} -> {text_dir} "
+                      f"({text_conv.num_rows} rows)")
+            else:
+                text_conv = materialize_sst2_text(text_dir, num_rows=8_192)
+            corpus = (
+                str(s)
+                for b in text_conv.make_batch_iterator(
+                    1024, epochs=1, shuffle=False, drop_last=False,
+                    columns=("sentence",),
+                )
+                for s in b["sentence"]
+            )
+            tok = train_bpe(
+                corpus, vocab_size=min(model.cfg.vocab_size, 4096)
+            )
+            tok.save(bpe_dir)
+            print(f"trained byte-level BPE ({len(tok.vocab)} tokens, "
+                  f"{len(tok.merges)} merges) -> {bpe_dir}")
+            conv = tokenize_text_dataset(
+                text_dir, ids_dir, tok, seq_len=args.seq_len
+            )
+        batches = (
+            normalize_sst2_batch(b)
+            for b in conv.make_batch_iterator(
+                args.batch, epochs=None, shuffle=True, seed=cfg.seed
+            )
+        )
+    else:
+        batches = synthetic_token_batches(
+            args.batch,
+            seq_len=args.seq_len,
+            vocab_size=model.cfg.vocab_size,
+            num_classes=cfg.num_classes,
+            seed=cfg.seed,
+            num_batches=args.steps + warmup,
+        )
     logger = MetricLogger(args.log_dir) if args.log_dir else None
     rng = jax.random.key(cfg.seed + 1)
     # Warmup fit absorbs compile so the throughput print is steady-state

@@ -1,10 +1,16 @@
-"""BERT / SST-2 fine-tune (BASELINE.json configs[1]).
+"""BERT fine-tune workloads (BASELINE.json configs[1] and configs[3]).
+
+  python notebooks/nlp/train_sst2.py                              # configs[1]
+  python notebooks/nlp/train_sst2.py --config bert_large_v4_32    # configs[3]
 
 The NLP workload the reference declares but never ships (reference
 notebooks/nlp/README.md is an empty placeholder — SURVEY.md §0), built
 TPU-native: Flax BERT through the attend() seam, Optax AdamW with warmup,
 pjit over the (dp, fsdp, sp, tp) mesh, samples/sec + MFU reported the way
-BASELINE.json `metric`/`north_star` ask.
+BASELINE.json `metric`/`north_star` ask. configs[3] is the
+HorovodRunner -> TpuDistributor migration config: its declared
+(dp=-1, fsdp=4) mesh clamps to the local chip count, and its global
+batch fits small meshes via gradient accumulation (--accum).
 
 --data-dir points at an SST-2-schema Parquet dataset fed through the
 converter layer (pass --materialize to generate a synthetic one there
@@ -31,7 +37,7 @@ from tpudl.data.datasets import eval_stream, split_train_eval
 from tpudl.data.synthetic import synthetic_token_batches
 from tpudl.models.registry import build_model
 from tpudl.parallel.sharding import strategy_rules
-from tpudl.runtime import make_mesh
+from tpudl.runtime import apply_platform_env, make_mesh
 from tpudl.train import (
     compile_step,
     create_train_state,
@@ -48,11 +54,32 @@ from tpudl.train.metrics import (
 )
 from tpudl.train.optim import make_optimizer
 
+apply_platform_env()
+
+
+#: NLP fine-tune configs this driver accepts (configs[1] and configs[3];
+#: configs[4]'s LoRA vertical is notebooks/nlp/finetune_lora.py).
+NLP_CONFIGS = ("sst2_bert_base", "bert_large_v4_32")
+
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default="sst2_bert_base",
+                        choices=NLP_CONFIGS,
+                        help="BASELINE.json config to drive; the declared "
+                        "mesh auto-clamps to the local device count "
+                        "(MeshSpec.fit), so bert_large_v4_32 trains on one "
+                        "chip and shards fsdp=4 on a pod")
     parser.add_argument("--steps", type=int, default=200)
     parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--accum", type=int, default=None,
+                        help="gradient-accumulation microbatches "
+                        "(default: config accum_steps)")
+    parser.add_argument("--remat", type=str, default=None,
+                        choices=["none", "layer", "attention", "dots"],
+                        help="rematerialization scope for BERT models "
+                        "(default: model default; 'dots' = layer remat "
+                        "with the dots_saveable policy)")
     parser.add_argument("--model", type=str, default=None,
                         help="override config model (e.g. bert-tiny for smoke)")
     parser.add_argument("--seq-len", type=int, default=None)
@@ -60,6 +87,10 @@ def main():
                         help="SST-2-schema Parquet dataset directory")
     parser.add_argument("--materialize", action="store_true",
                         help="generate a synthetic dataset into --data-dir first")
+    parser.add_argument("--ingest", type=str, default=None,
+                        help="REAL GLUE SST-2 TSV (train.tsv or the SST-2 "
+                        "directory): ingested into the --text-data text "
+                        "Parquet before tokenization (tpudl.data.ingest)")
     parser.add_argument(
         "--text-data", action="store_true",
         help="raw-text vertical: materialize a TEXT-schema dataset "
@@ -86,6 +117,8 @@ def main():
     args = parser.parse_args()
     if (args.materialize or args.text_data) and not args.data_dir:
         parser.error("--materialize/--text-data require --data-dir")
+    if args.ingest and not args.text_data:
+        parser.error("--ingest feeds the raw-text vertical: add --text-data")
 
     overrides = {}
     if args.model:
@@ -100,20 +133,31 @@ def main():
         overrides["mesh"] = MeshSpec(
             *(int(x) for x in args.mesh.split(","))
         )
-    cfg = get_config("sst2_bert_base", **overrides)
+    cfg = get_config(args.config, **overrides)
     batch_size = args.batch or cfg.global_batch_size
     seq_len = args.seq_len or cfg.seq_len
+    accum = args.accum if args.accum is not None else cfg.accum_steps
 
-    mesh = make_mesh(cfg.mesh)
+    model_kwargs = {}
+    if args.remat:
+        from tpudl.models.bert import remat_options
+
+        model_kwargs.update(remat_options(args.remat))
+
+    # An explicit --mesh is taken literally; the config's declared mesh
+    # clamps to whatever devices this host actually has.
+    mesh_spec = cfg.mesh if args.mesh else cfg.mesh.fit(jax.device_count())
+    mesh = make_mesh(mesh_spec)
     if cfg.strategy == "pp":
         from tpudl.models.registry import build_pipelined_model
 
         model = build_pipelined_model(
             cfg.model, cfg.num_classes,
             num_stages=mesh.shape["pp"], num_microbatches=args.microbatches,
+            **model_kwargs,
         )
     else:
-        model = build_model(cfg.model, cfg.num_classes)
+        model = build_model(cfg.model, cfg.num_classes, **model_kwargs)
     sample_ids = jnp.zeros((1, seq_len), jnp.int32)
     state = create_train_state(
         jax.random.key(cfg.seed),
@@ -124,13 +168,15 @@ def main():
     num_params = sum(
         p.size for p in jax.tree_util.tree_leaves(state.params)
     )
-    print(f"{cfg.model}: {num_params / 1e6:.1f}M params, batch {batch_size}, "
-          f"seq {seq_len}, strategy {cfg.strategy}")
+    print(f"{cfg.name}: {cfg.model} {num_params / 1e6:.1f}M params, "
+          f"batch {batch_size} (accum {accum}), seq {seq_len}, "
+          f"strategy {cfg.strategy}, mesh {dict(mesh.shape)}")
 
     rules = strategy_rules(cfg.strategy)
     step = compile_step(
         make_classification_train_step(
-            input_keys=("input_ids", "attention_mask"), label_key="label"
+            input_keys=("input_ids", "attention_mask"), label_key="label",
+            accum_steps=accum,
         ),
         mesh,
         state,
@@ -156,13 +202,20 @@ def main():
         text_dir = os.path.join(args.data_dir, "text")
         ids_dir = os.path.join(args.data_dir, "ids")
         vocab_path = os.path.join(args.data_dir, "vocab.txt")
-        if os.path.isdir(ids_dir) and not args.materialize:
+        if os.path.isdir(ids_dir) and not (args.materialize or args.ingest):
             # Petastorm contract: materialize once, train many. Pass
             # --materialize to force regeneration.
             print(f"reusing tokenized dataset {ids_dir} (vocab {vocab_path})")
             conv = _mk(ids_dir)
         else:
-            text_conv = materialize_sst2_text(text_dir, num_rows=8_192)
+            if args.ingest:
+                from tpudl.data.ingest import ingest_sst2_tsv
+
+                text_conv = ingest_sst2_tsv(args.ingest, text_dir)
+                print(f"ingested {args.ingest} -> {text_dir} "
+                      f"({text_conv.num_rows} rows)")
+            else:
+                text_conv = materialize_sst2_text(text_dir, num_rows=8_192)
             corpus = (
                 str(s)
                 for b in text_conv.make_batch_iterator(
@@ -185,7 +238,10 @@ def main():
                 batch_size, epochs=None, shuffle=True, seed=cfg.seed
             )
         )
-        eval_raw = eval_stream(eval_conv, batch_size, normalize_sst2_batch)
+        eval_raw = eval_stream(
+            eval_conv, batch_size, normalize_sst2_batch,
+            batch_divisor=mesh.shape["dp"] * mesh.shape["fsdp"],
+        )
     elif args.data_dir:
         from tpudl.data.datasets import materialize_sst2_like, normalize_sst2_batch
 
@@ -203,7 +259,10 @@ def main():
                 batch_size, epochs=None, shuffle=True, seed=cfg.seed
             )
         )
-        eval_raw = eval_stream(eval_conv, batch_size, normalize_sst2_batch)
+        eval_raw = eval_stream(
+            eval_conv, batch_size, normalize_sst2_batch,
+            batch_divisor=mesh.shape["dp"] * mesh.shape["fsdp"],
+        )
     else:
         raw = synthetic_token_batches(
             batch_size,

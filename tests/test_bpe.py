@@ -1,0 +1,110 @@
+"""Byte-level BPE: trainer invariants + byte-parity with
+transformers.GPT2Tokenizer over the same vocab.json/merges.txt (the same
+strategy as the WordPiece-vs-BertTokenizer parity tests — a locally
+constructed reference tokenizer, zero egress)."""
+
+import numpy as np
+import pytest
+
+from tpudl.data.bpe import (
+    EOT_TOKEN,
+    PAD_TOKEN,
+    ByteBPETokenizer,
+    bytes_to_unicode,
+    train_bpe,
+)
+
+CORPUS = [
+    "the movie was wonderful and the acting was wonderful too",
+    "a dull and lifeless film , utterly forgettable",
+    "it's a charming journey with heartfelt moments",
+    "the plot was dull but the ending was charming",
+    "don't watch this dreadful mess of a movie",
+    "truly a wonderful story , wonderfully told",
+    "unicode test: naïve café — 日本語 and emoji 🎬 survive bytes",
+] * 3
+
+
+def test_bytes_to_unicode_reversible():
+    m = bytes_to_unicode()
+    assert len(m) == 256
+    assert len(set(m.values())) == 256  # bijective
+
+
+def test_train_encode_decode_roundtrip():
+    tok = train_bpe(CORPUS, vocab_size=512)
+    assert tok.vocab[PAD_TOKEN] == 0
+    assert len(tok.vocab) <= 512
+    for text in CORPUS[:7]:
+        ids = tok.encode_text(text)
+        assert tok.decode(ids) == text  # byte-level: lossless, any input
+    # merges actually learned: frequent words compress below char count
+    assert len(tok.encode_text("wonderful")) < len("wonderful")
+
+
+def test_encode_batch_contract():
+    tok = train_bpe(CORPUS, vocab_size=512)
+    batch = tok(["the movie was wonderful", "dull film"], max_len=16)
+    assert batch["input_ids"].shape == (2, 16)
+    assert batch["input_ids"].dtype == np.int32
+    assert batch["input_ids"][0, 0] == tok.bos_id
+    # mask marks exactly the non-pad prefix
+    lens = batch["attention_mask"].sum(axis=1)
+    for row, n in zip(batch["input_ids"], lens):
+        assert (row[n:] == tok.pad_id).all()
+        assert (row[:n] != tok.pad_id).all()
+
+
+def test_truncation():
+    tok = train_bpe(CORPUS, vocab_size=512)
+    ids, mask = tok.encode("the movie was wonderful and charming", max_len=4)
+    assert len(ids) == 4 and sum(mask) == 4
+
+
+def test_file_roundtrip(tmp_path):
+    tok = train_bpe(CORPUS, vocab_size=512)
+    tok.save(str(tmp_path))
+    tok2 = ByteBPETokenizer.from_files(
+        str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt")
+    )
+    for text in CORPUS[:7]:
+        assert tok.encode_text(text) == tok2.encode_text(text)
+
+
+def test_gpt2_tokenizer_parity(tmp_path):
+    """Our encoder byte-matches transformers.GPT2Tokenizer over the SAME
+    trained vocab/merges files — so real pretrained pairs drop in."""
+    transformers = pytest.importorskip("transformers")
+
+    tok = train_bpe(CORPUS, vocab_size=768)
+    vocab_path, merges_path = tok.save(str(tmp_path))
+    hf = transformers.GPT2Tokenizer(
+        vocab_path, merges_path,
+        unk_token=EOT_TOKEN, bos_token=EOT_TOKEN, eos_token=EOT_TOKEN,
+    )
+    cases = CORPUS[:7] + [
+        "Unseen Words With Capitals!",
+        "  leading and trailing spaces  ",
+        "numbers 12345 and punct ?!...",
+        "brand-new-hyphenated-compound",
+    ]
+    for text in cases:
+        ours = tok.encode_text(text)
+        theirs = hf.convert_tokens_to_ids(hf.tokenize(text))
+        assert ours == theirs, (text, ours, theirs)
+
+
+def test_tokenize_text_dataset_accepts_bpe(tmp_path):
+    """The Parquet text->ids pipeline takes the BPE tokenizer through the
+    same seam as WordPiece (the tokenizer __call__ contract)."""
+    from tpudl.data.datasets import materialize_sst2_text, tokenize_text_dataset
+
+    materialize_sst2_text(str(tmp_path / "text"), num_rows=256)
+    tok = train_bpe(CORPUS, vocab_size=512)
+    conv = tokenize_text_dataset(
+        str(tmp_path / "text"), str(tmp_path / "ids"), tok, seq_len=32
+    )
+    b = next(conv.make_batch_iterator(32, shuffle=False, shard_index=0,
+                                      num_shards=1))
+    assert b["input_ids"].shape == (32, 32)
+    assert (b["input_ids"][:, 0] == tok.bos_id).all()

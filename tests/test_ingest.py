@@ -1,0 +1,172 @@
+"""Real-data ingesters, round-tripped against fixtures written in the
+REAL distribution formats (CIFAR-10 python pickles with bytes keys and
+CHW plane rows inside a tar.gz; GLUE SST-2 tab-separated-no-quoting TSV)
+— the dataset counterpart of the HF-weight import parity tests."""
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from tpudl.data.ingest import ingest_cifar10, ingest_sst2_tsv
+
+
+def _cifar_fixture_batch(rng, n):
+    """(pickle dict in the real format, expected HWC images, labels)."""
+    hwc = rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8)
+    # real format: [N, 3072] = R plane then G then B, row-major per plane
+    rows = hwc.transpose(0, 3, 1, 2).reshape(n, 3072)
+    labels = rng.integers(0, 10, size=(n,))
+    d = {
+        b"data": rows,
+        b"labels": labels.tolist(),
+        b"batch_label": b"testing batch 1 of 5",
+        b"filenames": [b"x.png"] * n,
+    }
+    return d, hwc, labels.astype(np.int64)
+
+
+def _write_cifar_archive(tmp_path, batches, as_tar):
+    """Write data_batch_i pickles either extracted or inside a tar.gz
+    under the real cifar-10-batches-py/ prefix."""
+    root = tmp_path / "cifar-10-batches-py"
+    root.mkdir()
+    for i, (d, _, _) in enumerate(batches, start=1):
+        with open(root / f"data_batch_{i}", "wb") as f:
+            pickle.dump(d, f)
+    # test_batch always present like the real archive
+    with open(root / "test_batch", "wb") as f:
+        pickle.dump(batches[0][0], f)
+    if not as_tar:
+        return str(tmp_path)
+    tar_path = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(root, arcname="cifar-10-batches-py")
+    return str(tar_path)
+
+
+@pytest.mark.parametrize("as_tar", [False, True])
+def test_ingest_cifar10_roundtrip(tmp_path, as_tar):
+    rng = np.random.default_rng(0)
+    batches = [_cifar_fixture_batch(rng, 40) for _ in range(5)]
+    src = _write_cifar_archive(tmp_path, batches, as_tar)
+
+    conv = ingest_cifar10(src, str(tmp_path / "out"))
+    assert conv.num_rows == 200
+    # ordered read-back equals the concatenated archive content, pixel
+    # for pixel in HWC orientation
+    got = {"image": [], "label": []}
+    for b in conv.make_batch_iterator(20, shuffle=False, drop_last=False,
+                                      shard_index=0, num_shards=1):
+        got["image"].append(b["image"])
+        got["label"].append(b["label"])
+    images = np.concatenate(got["image"])
+    labels = np.concatenate(got["label"])
+    want_images = np.concatenate([hwc for _, hwc, _ in batches])
+    want_labels = np.concatenate([lab for _, _, lab in batches])
+    np.testing.assert_array_equal(images, want_images)
+    np.testing.assert_array_equal(labels, want_labels)
+    assert images.dtype == np.uint8
+
+
+def test_ingest_cifar10_test_split_and_errors(tmp_path):
+    rng = np.random.default_rng(1)
+    batches = [_cifar_fixture_batch(rng, 8) for _ in range(5)]
+    src = _write_cifar_archive(tmp_path, batches, as_tar=False)
+    conv = ingest_cifar10(src, str(tmp_path / "t"), split="test")
+    assert conv.num_rows == 8
+    with pytest.raises(ValueError, match="train|test"):
+        ingest_cifar10(src, str(tmp_path / "x"), split="val")
+    with pytest.raises(FileNotFoundError):
+        ingest_cifar10(str(tmp_path / "nowhere"), str(tmp_path / "y"))
+
+
+def test_ingest_cifar10_feeds_training_pipeline(tmp_path):
+    """Ingested real-format data flows through the augmenter exactly like
+    the synthetic materializer's output."""
+    from tpudl.data.augment import BatchAugmenter
+
+    rng = np.random.default_rng(2)
+    batches = [_cifar_fixture_batch(rng, 16) for _ in range(5)]
+    src = _write_cifar_archive(tmp_path, batches, as_tar=True)
+    conv = ingest_cifar10(src, str(tmp_path / "out"))
+    aug = BatchAugmenter(crop=(32, 32), pad=4, seed=0)
+    b = next(conv.make_batch_iterator(16, shuffle=True, shard_index=0,
+                                      num_shards=1, transform=aug))
+    assert b["image"].shape == (16, 32, 32, 3)
+    assert b["image"].dtype == np.float32
+
+
+def test_ingest_sst2_tsv_roundtrip(tmp_path):
+    # Real GLUE SST-2 format: header, tab-separated, NO quoting — include
+    # sentences with quotes/commas that would break csv-module parsing.
+    rows = [
+        ("hide new secretions from the parental units", 0),
+        ('contains no wit , only labored "gags"', 0),
+        ("that loves its characters and communicates something", 1),
+        ("remains utterly satisfied to remain the same throughout", 0),
+        ("it's a charming and often affecting journey", 1),
+    ]
+    tsv = tmp_path / "SST-2" / "train.tsv"
+    tsv.parent.mkdir()
+    with open(tsv, "w", encoding="utf-8") as f:
+        f.write("sentence\tlabel\n")
+        for s, lab in rows:
+            f.write(f"{s}\t{lab}\n")
+
+    # by file path and by GLUE directory
+    for src in (str(tsv), str(tmp_path / "SST-2")):
+        out = str(tmp_path / f"out-{os.path.basename(src)}")
+        conv = ingest_sst2_tsv(src, out)
+        b = next(conv.make_batch_iterator(5, shuffle=False, drop_last=False,
+                                          shard_index=0, num_shards=1))
+        assert [str(s) for s in b["sentence"]] == [s for s, _ in rows]
+        assert b["label"].tolist() == [lab for _, lab in rows]
+
+
+def test_ingest_sst2_tsv_tokenizer_vertical(tmp_path):
+    """TSV -> text Parquet -> WordPiece ids Parquet, the full raw-text
+    chain on real-format input."""
+    from tpudl.data.datasets import tokenize_text_dataset
+    from tpudl.data.tokenizer import WordPieceTokenizer, build_wordpiece_vocab
+
+    tsv = tmp_path / "train.tsv"
+    with open(tsv, "w", encoding="utf-8") as f:
+        f.write("sentence\tlabel\n")
+        for i in range(64):
+            s = "a fine movie" if i % 2 else "a dull movie"
+            f.write(f"{s}\t{i % 2}\n")
+    text_conv = ingest_sst2_tsv(str(tsv), str(tmp_path / "text"))
+    corpus = (
+        str(s)
+        for b in text_conv.make_batch_iterator(
+            16, epochs=1, shuffle=False, drop_last=False,
+            shard_index=0, num_shards=1, columns=("sentence",),
+        )
+        for s in b["sentence"]
+    )
+    tok = WordPieceTokenizer(build_wordpiece_vocab(corpus, 128))
+    ids_conv = tokenize_text_dataset(
+        str(tmp_path / "text"), str(tmp_path / "ids"), tok, seq_len=16
+    )
+    b = next(ids_conv.make_batch_iterator(32, shuffle=False,
+                                          shard_index=0, num_shards=1))
+    assert b["input_ids"].shape == (32, 16)
+    assert set(b["label"].tolist()) == {0, 1}
+
+
+def test_ingest_sst2_tsv_errors(tmp_path):
+    bad = tmp_path / "bad.tsv"
+    with open(bad, "w") as f:
+        f.write("foo\tbar\n")
+        f.write("x\t1\n")
+    with pytest.raises(ValueError, match="lacks"):
+        ingest_sst2_tsv(str(bad), str(tmp_path / "o"))
+    short = tmp_path / "short.tsv"
+    with open(short, "w") as f:
+        f.write("sentence\tlabel\n")
+        f.write("only-sentence-no-tab\n")
+    with pytest.raises(ValueError, match="short row"):
+        ingest_sst2_tsv(str(short), str(tmp_path / "o2"))

@@ -303,22 +303,30 @@ def split_train_eval(conv, eval_fraction: float = 0.1):
     return train, holdout
 
 
-def eval_stream(eval_conv, batch_size: int, normalize):
+def eval_stream(eval_conv, batch_size: int, normalize, batch_divisor: int = 1):
     """Re-iterable held-out batch stream (tpudl.train.evaluate drains one
     epoch per call). A holdout smaller than one batch PER SHARD keeps its
     partial batch (drop_last=False) so evaluate() sees at least one batch
-    instead of raising — fine single-process; on a sharded mesh size such
-    holdouts to the batch axes."""
+    instead of raising. ``batch_divisor`` (the mesh's dp*fsdp batch-shard
+    count) trims any partial batch down to a divisible row count — a
+    12-row final batch on an 8-way batch sharding would otherwise fail
+    pjit's divisibility check; batches smaller than the divisor are
+    skipped (at most divisor-1 rows of the holdout go unevaluated,
+    reported example-weighted by evaluate())."""
     import jax
 
     drop_last = len(eval_conv) // jax.process_count() >= batch_size
 
     def gen():
-        return (
-            normalize(b)
-            for b in eval_conv.make_batch_iterator(
-                batch_size, epochs=1, shuffle=False, drop_last=drop_last
-            )
-        )
+        for b in eval_conv.make_batch_iterator(
+            batch_size, epochs=1, shuffle=False, drop_last=drop_last
+        ):
+            n = len(next(iter(b.values())))
+            keep = (n // batch_divisor) * batch_divisor
+            if keep == 0:
+                continue
+            if keep != n:
+                b = {k: v[:keep] for k, v in b.items()}
+            yield normalize(b)
 
     return gen

@@ -1,0 +1,194 @@
+"""Real-dataset ingesters: on-disk archive formats -> tpudl Parquet.
+
+The reference's first acts are loading real pretrained weights and a real
+input file (reference notebooks/cv/onnx_experiments.py:19,47-50). tpudl
+ingests real HF *weights* via params_from_hf_bert/llama; this module is
+the *dataset* counterpart — it converts the standard on-disk distribution
+formats into the schemas the converter layer already consumes, so
+"drop real data in" is one function call, not an exercise for the user:
+
+- ``ingest_cifar10``: the CIFAR-10 python-pickle archive
+  (cifar-10-python.tar.gz, or its extracted cifar-10-batches-py/
+  directory of data_batch_1..5 + test_batch pickles, each a dict with
+  b"data" [N, 3072] uint8 rows in CHW plane order and b"labels") ->
+  the CIFAR image/label Parquet schema
+  (tpudl.data.datasets.materialize_cifar10_like's schema).
+- ``ingest_sst2_tsv``: a GLUE SST-2 TSV (header ``sentence\\tlabel``,
+  tab-separated, no quoting — the glue_data/SST-2/{train,dev}.tsv
+  layout) -> the raw-text Parquet schema
+  (tpudl.data.datasets.materialize_sst2_text's schema), feeding the
+  tokenizer vertical (tokenize_text_dataset) unchanged.
+
+Everything downstream (converter sharding/shuffle, augmenter, training
+notebooks) is untouched — that is the Petastorm "materialize once, train
+many" contract (BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import List
+
+import numpy as np
+
+from tpudl.data.converter import make_converter, write_parquet
+
+#: Member names inside the CIFAR-10 python archive, in canonical order.
+_CIFAR_TRAIN_BATCHES = tuple(f"data_batch_{i}" for i in range(1, 6))
+_CIFAR_TEST_BATCH = "test_batch"
+
+
+def _cifar_rows_to_hwc(data: np.ndarray) -> np.ndarray:
+    """[N, 3072] uint8 rows (1024 R + 1024 G + 1024 B planes, row-major
+    within each plane) -> [N, 32, 32, 3] uint8 HWC."""
+    if data.ndim != 2 or data.shape[1] != 3072:
+        raise ValueError(
+            f"CIFAR-10 batch rows must be [N, 3072], got {data.shape}"
+        )
+    return (
+        data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.uint8)
+    )
+
+
+def _load_cifar_batch(fileobj) -> tuple:
+    """One CIFAR-10 pickle (the real distribution pickles with bytes keys
+    under py3's encoding='bytes') -> (images HWC uint8, labels int64)."""
+    d = pickle.load(fileobj, encoding="bytes")
+    data = d.get(b"data", d.get("data"))
+    labels = d.get(b"labels", d.get("labels"))
+    if data is None or labels is None:
+        raise ValueError(
+            f"not a CIFAR-10 batch pickle (keys: {list(d)[:6]})"
+        )
+    return _cifar_rows_to_hwc(np.asarray(data)), np.asarray(
+        labels, np.int64
+    )
+
+
+def ingest_cifar10(
+    source: str,
+    out_dir: str,
+    split: str = "train",
+    rows_per_file: int = 10_000,
+):
+    """CIFAR-10 python archive -> image/label Parquet dataset.
+
+    ``source``: the distribution tarball (cifar-10-python.tar.gz), the
+    extracted cifar-10-batches-py/ directory, or a directory containing
+    it. ``split``: "train" (data_batch_1..5 -> one Parquet part per
+    batch file) or "test" (test_batch). Returns a Converter over
+    ``out_dir``; feed it to the CIFAR notebook exactly like a
+    materialized synthetic dataset:
+
+        python notebooks/cv/train_cifar10.py \\
+            --ingest /path/cifar-10-python.tar.gz --data-dir /tmp/c10
+    """
+    if split == "train":
+        members = list(_CIFAR_TRAIN_BATCHES)
+    elif split == "test":
+        members = [_CIFAR_TEST_BATCH]
+    else:
+        raise ValueError(f"split must be train|test, got {split!r}")
+
+    batches: List[tuple] = []
+    if os.path.isfile(source):
+        with tarfile.open(source, "r:*") as tf:
+            by_base = {
+                os.path.basename(m.name): m
+                for m in tf.getmembers()
+                if m.isfile()
+            }
+            for name in members:
+                if name not in by_base:
+                    raise FileNotFoundError(
+                        f"{name} not found in archive {source}"
+                    )
+                batches.append(_load_cifar_batch(tf.extractfile(by_base[name])))
+    else:
+        base = source
+        nested = os.path.join(source, "cifar-10-batches-py")
+        if not os.path.exists(os.path.join(base, members[0])) and os.path.isdir(
+            nested
+        ):
+            base = nested
+        for name in members:
+            path = os.path.join(base, name)
+            if not os.path.exists(path):
+                raise FileNotFoundError(path)
+            with open(path, "rb") as f:
+                batches.append(_load_cifar_batch(f))
+
+    part = 0
+    for images, labels in batches:
+        write_parquet(
+            out_dir,
+            {"image": images, "label": labels},
+            rows_per_file=rows_per_file,
+            part_offset=part,
+        )
+        part += -(-len(labels) // rows_per_file)
+    return make_converter(out_dir)
+
+
+def ingest_sst2_tsv(
+    source: str,
+    out_dir: str,
+    split: str = "train",
+    rows_per_file: int = 16_384,
+    sentence_column: str = "sentence",
+    label_column: str = "label",
+):
+    """GLUE SST-2 TSV -> raw-text (sentence, label) Parquet dataset.
+
+    ``source``: a .tsv file, or the glue SST-2 directory holding
+    {train,dev}.tsv (``split`` picks which). The GLUE format is a
+    header line then tab-separated rows with NO quoting (sentences may
+    contain anything but tab/newline), so parsing is a literal
+    ``split("\\t")`` — csv-module quoting rules would corrupt sentences
+    containing quote characters. Returns a Converter over ``out_dir``
+    whose output feeds tokenize_text_dataset (the raw-text vertical):
+
+        python notebooks/nlp/train_sst2.py --text-data \\
+            --ingest /path/SST-2/train.tsv --data-dir /tmp/sst2
+    """
+    path = source
+    if os.path.isdir(source):
+        path = os.path.join(source, f"{split}.tsv")
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+
+    sentences: List[str] = []
+    labels: List[int] = []
+    with open(path, encoding="utf-8") as f:
+        header = f.readline().rstrip("\n").split("\t")
+        try:
+            s_idx = header.index(sentence_column)
+            l_idx = header.index(label_column)
+        except ValueError:
+            raise ValueError(
+                f"{path} header {header} lacks "
+                f"{sentence_column!r}/{label_column!r} columns"
+            )
+        for lineno, line in enumerate(f, start=2):
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            parts = line.split("\t")
+            if len(parts) <= max(s_idx, l_idx):
+                raise ValueError(f"{path}:{lineno}: short row {parts!r}")
+            sentences.append(parts[s_idx])
+            labels.append(int(parts[l_idx]))
+
+    if not sentences:
+        raise ValueError(f"{path} contains no data rows")
+    write_parquet(
+        out_dir,
+        {
+            "sentence": np.asarray(sentences, dtype=object),
+            "label": np.asarray(labels, np.int64),
+        },
+        rows_per_file=rows_per_file,
+    )
+    return make_converter(out_dir)

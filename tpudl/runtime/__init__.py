@@ -10,6 +10,7 @@ from tpudl.runtime.mesh import (  # noqa: F401
     AXIS_TENSOR,
     MESH_AXES,
     MeshSpec,
+    apply_platform_env,
     batch_partition_spec,
     make_mesh,
 )

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -95,6 +96,45 @@ class MeshSpec:
 
     def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
         return make_mesh(self, devices)
+
+    def fit(self, num_devices: int) -> "MeshSpec":
+        """Clamp this spec to a device count it doesn't fit — each fixed
+        axis shrinks to gcd(size, remaining devices) in declaration order,
+        the wildcard absorbs the rest. A config declared for a v4-32
+        (e.g. dp=-1, fsdp=4) then runs unchanged on the pod but clamps to
+        (1,1,1,1,1,1) on the one local chip, so every BASELINE.json config
+        is drivable anywhere. Requires a wildcard axis (all tpudl configs
+        declare dp=-1)."""
+        sizes = [self.dp, self.fsdp, self.sp, self.tp, self.pp, self.ep]
+        if -1 not in sizes:
+            raise ValueError(
+                f"fit() needs a wildcard (-1) axis to absorb devices, got "
+                f"{sizes}"
+            )
+        remaining = num_devices
+        fitted = []
+        for s in sizes:
+            if s == -1:
+                fitted.append(-1)
+                continue
+            s = math.gcd(s, remaining)
+            fitted.append(s)
+            remaining //= s
+        return MeshSpec(*fitted)
+
+
+def apply_platform_env() -> None:
+    """Honor TPUDL_PLATFORM (e.g. "cpu") before any device use.
+
+    The axon sitecustomize pins the TPU platform via an explicit config
+    update, which beats JAX_PLATFORMS — so workload scripts call this at
+    the top of main() to let tests (and users without a TPU) force the
+    CPU backend, typically with
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 for a fake mesh.
+    """
+    platform = os.environ.get("TPUDL_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
 
 
 def make_mesh(
