@@ -165,9 +165,9 @@ def attend(
       "reference" — this module's einsum attention (any backend);
       "fused"     — Pallas TPU fused short-seq kernel (full softmax per
                     cell, one-pass backward, IN-KERNEL attention dropout
-                    from the hardware PRNG — the only non-reference
-                    implementation that supports dropout_rate > 0);
-      "flash"     — Pallas TPU flash-attention kernel;
+                    from the hardware PRNG);
+      "flash"     — Pallas TPU flash-attention kernel (streaming online
+                    softmax; in-kernel dropout at any length);
       "ring"      — sequence-parallel ring attention over the `sp` mesh
                     axis (ppermute K/V rotation, online-softmax merge);
       "ulysses"   — sequence-parallel attention via all-to-all head/seq
@@ -176,8 +176,9 @@ def attend(
                     reference numerics on CPU — ulysses_attention's
                     local_impl parameter pins either).
 
-    Attention-probability dropout is supported by the reference and fused
-    implementations; flash/ring/ulysses reject a nonzero rate rather than
+    Attention-probability dropout is supported by the reference, fused,
+    and flash implementations (the Pallas kernels draw in-kernel from the
+    TPU hardware PRNG); ring/ulysses reject a nonzero rate rather than
     silently dropping it (fine-tune with attention_dropout=0 on those
     paths).
     """
@@ -220,24 +221,23 @@ def attend(
                 q, k, v, mask=mask, causal=causal,
                 dropout_rate=dropout_rate, dropout_rng=dropout_rng,
             )
-        if dropout_rate > 0.0:
-            raise ValueError(
-                f"attention dropout beyond seq {MAX_SEQ} needs the "
-                f"streaming flash kernel, which does not support it — "
-                f"set attention_dropout=0 for long-context training"
-            )
+        # Past MAX_SEQ the streaming flash kernel takes over — WITH
+        # in-kernel dropout (the round-3 S>512 dropout carve-out is gone;
+        # configs[4]'s seq-2048 fine-tune trains with real
+        # attention_dropout now). Falls through to the shared branch.
+        implementation = "flash"
+    if implementation == "flash":
         from tpudl.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, mask=mask, causal=causal)
+        return flash_attention(
+            q, k, v, mask=mask, causal=causal,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        )
     if dropout_rate > 0.0:
         raise ValueError(
             f"attention-probability dropout is not supported by the "
             f"{implementation!r} implementation; set attention_dropout=0.0"
         )
-    if implementation == "flash":
-        from tpudl.ops.flash_attention import flash_attention
-
-        return flash_attention(q, k, v, mask=mask, causal=causal)
     if implementation == "ring":
         from tpudl.ops.ring_attention import ring_attention
 

@@ -19,6 +19,22 @@ case), causal masks are generated in-kernel from block indices (the
 decoder case) — neither ever materializes an S×S array. Arbitrary dense
 [B, H, Sq, Skv] masks are not supported here; use the reference
 implementation for those.
+
+Dropout (attention-probability, BERT/Llama-style) runs IN-KERNEL from
+the TPU hardware PRNG using the same reseed-regenerate contract as
+tpudl.ops.fused_attention (tpudl.ops.pallas_utils): each logical
+(batch, head, q_tile, kv_tile) cell seeds its own stream keyed by the
+LOGICAL tile id — not the grid-order cell id, which differs between the
+kv-major dk/dv launch and the q-major forward/dq launches — and the
+backward regenerates the identical keep mask instead of storing it, so
+long-context dropout costs zero HBM. The online-softmax denominator
+accumulates UNDROPPED probabilities (dropout applies after softmax
+normalization); only the p@V numerator and the dp/dv backward terms are
+masked, and the standard delta = sum(do*o) identity still equals
+sum_j w'_j dp'_j under the mask, so the backward recurrences are
+unchanged in form. TPU-only (like the fused kernel): interpret mode has
+no hardware PRNG, so dropout_rate > 0 raises there; real-TPU
+verification lives in scripts/tpu_dropout_check.py.
 """
 
 from __future__ import annotations
@@ -87,6 +103,20 @@ def _tile_contributes(qi, kv, causal, block_q, block_k, causal_offset):
     return kv * block_k <= q_end
 
 
+def _dropout_keep(seed_ref, bi, hi, qi, kv, nh, nq, nkv, shape, rate):
+    """Regenerate the dropout keep-mask for logical tile (bi, hi, qi, kv).
+
+    Seeded by the LOGICAL flattened tile id so the q-major forward/dq
+    grids and the kv-major dk/dv grid reproduce bit-identical masks for
+    the same tile (the pallas_utils reseed contract). One
+    prng_random_bits draw per cell, immediately after seeding."""
+    from tpudl.ops.pallas_utils import keep_mask, seed_cell
+
+    cell = ((bi * nh + hi) * nq + qi) * nkv + kv
+    seed_cell(seed_ref, cell)
+    return keep_mask(shape, rate)
+
+
 def _tile_keep(kvm_row, qi, kv, causal, block_q, block_k, causal_offset,
                has_kvmask):
     """[block_q, block_k] attend-mask for one tile (or None when nothing
@@ -110,10 +140,10 @@ def _tile_keep(kvm_row, qi, kv, causal, block_q, block_k, causal_offset,
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, scale, causal, block_q, block_k, causal_offset,
-                has_kvmask):
+                has_kvmask, rate):
     qi, kv = pl.program_id(2), pl.program_id(3)
     nkv = pl.num_programs(3)
 
@@ -142,9 +172,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
         if keep is not None:
             p = jnp.where(keep, p, 0.0)
         corr = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        # Denominator: UNDROPPED p (dropout acts after normalization).
         l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            keep_d = _dropout_keep(
+                seed_ref, pl.program_id(0), pl.program_id(1), qi, kv,
+                pl.num_programs(1), pl.num_programs(2), nkv,
+                (block_q, block_k), rate,
+            )
+            p_num = jnp.where(keep_d, p, 0.0)
+        else:
+            p_num = p
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0, :, :],
+            p_num.astype(v_ref.dtype), v_ref[0, 0, :, :],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -154,12 +194,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvm_ref, o_ref, lse_ref,
     def _finalize():
         l = l_scr[:, :1]
         l_safe = jnp.where(l > 0.0, l, 1.0)
-        o_ref[0, 0, :, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        out = acc_scr[:] / l_safe
+        if rate > 0.0:
+            out = out * (1.0 / (1.0 - rate))
+        o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
         lse_ref[0, 0, 0, :] = m_scr[:, 0] + jnp.log(l_safe[:, 0])
 
 
-def _fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret,
-         has_mask=True):
+def _fwd(q, k, v, kvmask, seed, causal, scale, block_q, block_k, interpret,
+         has_mask=True, rate=0.0):
     b, sq, h, d = q.shape
     skv = k.shape[1]
     bq, bk = _block_sizes(sq, skv, block_q, block_k)
@@ -180,13 +223,14 @@ def _fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret,
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            causal_offset=skv - sq, has_kvmask=has_kvmask,
+            causal_offset=skv - sq, has_kvmask=has_kvmask, rate=rate,
         ),
         grid=grid,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=_DIM_SEMANTICS
         ),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bk, d), lambda b, h, i, j: (b, h, j, 0),
@@ -212,7 +256,7 @@ def _fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, kvm)
+    )(seed, qt, kt, vt, kvm)
     return o, lse, (qt, kt, vt, kvm)
 
 
@@ -222,10 +266,10 @@ def _fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret,
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
-               dq_ref, dq_scr,
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref,
+               dlt_ref, dq_ref, dq_scr,
                *, scale, causal, block_q, block_k, causal_offset,
-               has_kvmask):
+               has_kvmask, rate):
     qi, kv = pl.program_id(2), pl.program_id(3)
     nkv = pl.num_programs(3)
 
@@ -253,6 +297,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if rate > 0.0:
+            # grad w.r.t. TRUE softmax p: g = keep_d * dp / (1-rate);
+            # delta (= sum(do*o)) already equals sum_j w'_j dp_j.
+            keep_d = _dropout_keep(
+                seed_ref, pl.program_id(0), pl.program_id(1), qi, kv,
+                pl.num_programs(1), pl.num_programs(2), nkv,
+                (block_q, block_k), rate,
+            )
+            dp = jnp.where(keep_d, dp * (1.0 / (1.0 - rate)), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -264,10 +317,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
         dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref,
+                dlt_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                 *, scale, causal, block_q, block_k, causal_offset,
-                has_kvmask):
+                has_kvmask, rate):
     kv, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -293,12 +346,26 @@ def _dkv_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
         p = jnp.exp(s - lse)
         if keep is not None:
             p = jnp.where(keep, p, 0.0)
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if rate > 0.0:
+            # kv-major grid: note qi/kv pulled from swapped program_ids,
+            # nq from axis 3 and nkv from axis 2 — the LOGICAL id matches
+            # the forward/dq launches bit-for-bit.
+            keep_d = _dropout_keep(
+                seed_ref, pl.program_id(0), pl.program_id(1), qi, kv,
+                pl.num_programs(1), nq, pl.num_programs(2),
+                (block_q, block_k), rate,
+            )
+            inv = 1.0 / (1.0 - rate)
+            p_num = jnp.where(keep_d, p * inv, 0.0)
+            dp = jnp.where(keep_d, dp * inv, 0.0)
+        else:
+            p_num = p
+        dv_scr[:] += jax.lax.dot_general(
+            p_num.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
@@ -317,27 +384,29 @@ def _dkv_kernel(q_ref, k_ref, v_ref, kvm_ref, do_ref, lse_ref, dlt_ref,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, kvmask, causal, scale, block_q, block_k, interpret,
-           has_mask):
-    o, _, _ = _fwd(q, k, v, kvmask, causal, scale, block_q, block_k,
-                   interpret, has_mask)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, kvmask, seed, causal, scale, block_q, block_k,
+           interpret, has_mask, rate):
+    o, _, _ = _fwd(q, k, v, kvmask, seed, causal, scale, block_q, block_k,
+                   interpret, has_mask, rate)
     return o[:, :, : q.shape[1], :].transpose(0, 2, 1, 3)
 
 
-def _flash_fwd(q, k, v, kvmask, causal, scale, block_q, block_k, interpret,
-               has_mask):
+def _flash_fwd(q, k, v, kvmask, seed, causal, scale, block_q, block_k,
+               interpret, has_mask, rate):
     o, lse, (qt, kt, vt, kvm) = _fwd(
-        q, k, v, kvmask, causal, scale, block_q, block_k, interpret, has_mask
+        q, k, v, kvmask, seed, causal, scale, block_q, block_k, interpret,
+        has_mask, rate,
     )
     out = o[:, :, : q.shape[1], :].transpose(0, 2, 1, 3)
     # Padded tensors are the residuals (no re-pad in bwd); the unpadded
     # kvmask rides along so bwd can recover the original Skv statically.
-    return out, (qt, kt, vt, kvm, kvmask, o, lse)
+    return out, (qt, kt, vt, kvm, kvmask, seed, o, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, res, g):
-    qt, kt, vt, kvm, kvmask, o, lse = res
+def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, rate,
+               res, g):
+    qt, kt, vt, kvm, kvmask, seed, o, lse = res
     b, h, sq_p, d = qt.shape
     skv_p = kt.shape[2]
     sq, skv = g.shape[1], kvmask.shape[1]
@@ -362,19 +431,21 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, res, g):
     row_spec = pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i),
                             memory_space=pltpu.VMEM)
 
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            causal_offset=skv - sq, has_kvmask=has_kvmask,
+            causal_offset=skv - sq, has_kvmask=has_kvmask, rate=rate,
         ),
         grid=(b, h, sq_p // bq, skv_p // bk),
         compiler_params=dim_sem,
-        in_specs=[q_spec, kv_spec, kv_spec, kvm_spec, q_spec, row_spec, row_spec],
+        in_specs=[seed_spec, q_spec, kv_spec, kv_spec, kvm_spec, q_spec,
+                  row_spec, row_spec],
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d), qt.dtype)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, kvm, do, lse, delta)[0]
+    )(seed, qt, kt, vt, kvm, do, lse, delta)[0]
 
     # kv-major grid: swap the roles of the last two grid axes in the specs.
     q_spec_t = pl.BlockSpec((1, 1, bq, d), lambda b, h, j, i: (b, h, i, 0),
@@ -388,12 +459,12 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, res, g):
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
-            causal_offset=skv - sq, has_kvmask=has_kvmask,
+            causal_offset=skv - sq, has_kvmask=has_kvmask, rate=rate,
         ),
         grid=(b, h, skv_p // bk, sq_p // bq),
         compiler_params=dim_sem,
-        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, kvm_spec_t, q_spec_t,
-                  row_spec_t, row_spec_t],
+        in_specs=[seed_spec, q_spec_t, kv_spec_t, kv_spec_t, kvm_spec_t,
+                  q_spec_t, row_spec_t, row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, skv_p, d), kt.dtype),
@@ -404,12 +475,12 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, res, g):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, kvm, do, lse, delta)
+    )(seed, qt, kt, vt, kvm, do, lse, delta)
 
     dq = dq[:, :, :sq, :].transpose(0, 2, 1, 3)
     dk = dk[:, :, :skv, :].transpose(0, 2, 1, 3)
     dv = dv[:, :, :skv, :].transpose(0, 2, 1, 3)
-    return dq, dk, dv, jnp.zeros_like(kvmask)
+    return dq, dk, dv, jnp.zeros_like(kvmask), jnp.zeros_like(seed)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -422,6 +493,8 @@ def flash_attention(
     mask: Optional[jax.Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
@@ -433,6 +506,12 @@ def flash_attention(
     [B, 1, 1, Skv] padding mask (tpudl.ops.attention.padding_mask output);
     dense [B, H, Sq, Skv] masks are rejected — use the reference
     implementation for those.
+
+    ``dropout_rate`` > 0 (with a ``dropout_rng``) applies in-kernel
+    attention-probability dropout from the TPU hardware PRNG (see module
+    docstring) — the long-context dropout path the einsum implementation
+    cannot afford (its mask alone is O(S^2) HBM). TPU-only: raises under
+    interpret mode, which has no hardware PRNG.
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
@@ -443,11 +522,25 @@ def flash_attention(
 
     from tpudl.ops.attention import normalize_kv_mask
 
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires a dropout_rng")
+        if interpret:
+            raise NotImplementedError(
+                "flash_attention dropout draws from the TPU hardware PRNG, "
+                "which interpret mode does not implement — run on TPU or "
+                "set dropout_rate=0"
+            )
+        seed = jax.random.bits(dropout_rng, (2,), jnp.uint32)
+    else:
+        seed = jnp.zeros((2,), jnp.uint32)
+
     has_mask = mask is not None
     kvmask = normalize_kv_mask(
         mask, b, skv, dtype=jnp.float32, impl="flash_attention"
     )
 
     return _flash(
-        q, k, v, kvmask, causal, scale, block_q, block_k, interpret, has_mask
+        q, k, v, kvmask, seed, causal, scale, block_q, block_k, interpret,
+        has_mask, float(dropout_rate),
     )
