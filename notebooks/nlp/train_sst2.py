@@ -100,7 +100,7 @@ def main():
     )
     parser.add_argument("--strategy", type=str, default=None,
                         help="override config strategy: dp | fsdp | tp | "
-                        "fsdp+tp | pp")
+                        "fsdp+tp | pp | pp+fsdp")
     parser.add_argument("--mesh", type=str, default=None,
                         help="dp,fsdp,sp,tp[,pp[,ep]] (e.g. 2,1,1,1,4)")
     parser.add_argument("--microbatches", type=int, default=4,
@@ -148,12 +148,13 @@ def main():
     # clamps to whatever devices this host actually has.
     mesh_spec = cfg.mesh if args.mesh else cfg.mesh.fit(jax.device_count())
     mesh = make_mesh(mesh_spec)
-    if cfg.strategy == "pp":
+    if cfg.strategy in ("pp", "pp+fsdp"):
         from tpudl.models.registry import build_pipelined_model
 
         model = build_pipelined_model(
             cfg.model, cfg.num_classes,
             num_stages=mesh.shape["pp"], num_microbatches=args.microbatches,
+            param_fsdp=cfg.strategy == "pp+fsdp",
             **model_kwargs,
         )
     else:

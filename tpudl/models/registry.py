@@ -48,11 +48,13 @@ def build_pipelined_model(
     num_classes: int,
     num_stages: int,
     num_microbatches: int,
+    param_fsdp: bool = False,
     **kwargs: Any,
 ):
-    """Config strategy='pp' model path: a BERT size name as a
+    """Config strategy='pp' / 'pp+fsdp' model path: a BERT size name as a
     PipelinedBertClassifier (tpudl.parallel.pipelined_bert) whose encoder
-    stages train sharded over the pp mesh axis."""
+    stages train sharded over the pp mesh axis — and, with ``param_fsdp``,
+    additionally 1/fsdp within each stage (ZeRO-in-pipeline)."""
     dtype = kwargs.pop("dtype", jnp.bfloat16)
     if name not in _BERT_SIZES:
         raise ValueError(
@@ -62,4 +64,6 @@ def build_pipelined_model(
     from tpudl.parallel.pipelined_bert import PipelinedBertClassifier
 
     cfg = _BERT_SIZES[name](num_labels=num_classes, dtype=dtype, **kwargs)
-    return PipelinedBertClassifier(cfg, num_stages, num_microbatches)
+    return PipelinedBertClassifier(
+        cfg, num_stages, num_microbatches, param_fsdp=param_fsdp
+    )

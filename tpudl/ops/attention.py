@@ -205,8 +205,8 @@ def attend(
         # and only softmax+dropout is worth fusing (hybrid); at mid S the
         # whole-attention kernel wins (S=256/512: 4.1/4.3 ms vs einsum's
         # 5.0/5.5 fwd+bwd); past MAX_SEQ its one-pass backward blows VMEM
-        # and flash's streaming design takes over (dropout unsupported
-        # there — flash raises on a nonzero rate).
+        # and flash's streaming design takes over (with its own
+        # in-kernel dropout — see the fallthrough below).
         from tpudl.ops.fused_attention import MAX_SEQ, fused_attention
 
         if q.shape[1] <= 256:

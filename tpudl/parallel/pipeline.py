@@ -41,6 +41,43 @@ def stage_param_spec(ndim: int, axis_name: str = AXIS_PIPE) -> P:
     return P(*([axis_name] + [None] * (ndim - 1)))
 
 
+def stage_fsdp_dim(
+    shape, fsdp_size: Optional[int] = None
+) -> Optional[int]:
+    """Which dim of a stacked stage param [pp, lps, ...] to additionally
+    shard over fsdp — the ONE source of truth shared by the sharding
+    rules (PIPELINED_BERT_FSDP_RULES) and the pipeline's shard_map
+    in_specs, which must agree exactly or every step pays a reshard.
+
+    Matrix-shaped leaves (rank >= 4: pp, layer, then >= 2 weight dims)
+    shard their largest weight dim; vectors (biases, LayerNorm scales)
+    stay replicated — gather traffic would exceed the memory saved.
+    With ``fsdp_size`` given (the shard_map in_specs path), dims the
+    extent doesn't divide return None; without it (the rules path),
+    divisibility is left to tree_shardings' clamp — the two bail out
+    under exactly the same condition."""
+    if len(shape) < 4:
+        return None
+    dim = max(range(2, len(shape)), key=lambda d: shape[d])
+    if fsdp_size is not None and (
+        fsdp_size <= 1 or shape[dim] % fsdp_size != 0
+    ):
+        return None
+    return dim
+
+
+def stage_param_spec_fsdp(
+    shape, fsdp_size: int, axis_name: str = AXIS_PIPE,
+    fsdp_axis: str = "fsdp",
+) -> P:
+    """stage_param_spec composed with fsdp sharding on stage_fsdp_dim."""
+    entries = [axis_name] + [None] * (len(shape) - 1)
+    dim = stage_fsdp_dim(shape, fsdp_size)
+    if dim is not None:
+        entries[dim] = fsdp_axis
+    return P(*entries)
+
+
 #: Sharding rules for stacked stage params: leading (stage) dim over pp.
 PIPELINE_RULES = ((r".*", lambda shape: stage_param_spec(len(shape))),)
 
@@ -81,15 +118,34 @@ def _pipeline_local(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     axis_name: str,
     num_microbatches: int,
+    fsdp_dims: Any = None,
+    fsdp_axis: str = "fsdp",
 ):
     """Per-device GPipe schedule. Runs inside shard_map over `axis_name`.
 
     params: this stage's weights (a [1, ...]-blocked shard of the stacked
     tree). x: the full [M, mb, ...] microbatched input, replicated over
     the pp axis (only stage 0 reads it).
+
+    ``fsdp_dims`` (pytree of int matching params' structure; -1 = leaf
+    not fsdp-sharded): ZeRO-style composition — leaves additionally
+    sharded over the fsdp mesh axis on that dim are all-gathered here,
+    ONCE per step before the tick scan (every tick reuses the same stage
+    weights). The gather's transpose is a reduce-scatter, so stage-weight
+    gradients come back fsdp-sharded — persistent params + optimizer
+    state stay 1/(pp*fsdp).
     """
     # The pp-sharded stacked params arrive as a [1, ...] block per device.
     params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+    if fsdp_dims is not None:
+        params = jax.tree.map(
+            lambda p, d: p if d < 0 else jax.lax.all_gather(
+                # dim d of the stacked [pp, lps, ...] leaf is d-1 after
+                # the stage-dim squeeze above
+                p, fsdp_axis, axis=d - 1, tiled=True
+            ),
+            params, fsdp_dims,
+        )
     n = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
     first = stage == 0
@@ -154,6 +210,8 @@ def pipeline(
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_PIPE,
     batch_spec: P = P(),
+    param_fsdp: bool = False,
+    fsdp_axis: str = "fsdp",
 ) -> Any:
     """Run `x` through a pipeline of stages spread over the `axis_name`
     mesh axis.
@@ -168,7 +226,13 @@ def pipeline(
       ``num_microbatches``;
     - ``batch_spec``: PartitionSpec entry for x's batch dim (e.g.
       ``P(('dp','fsdp'))`` when composing with data parallelism — the
-      microbatch split then happens per data shard).
+      microbatch split then happens per data shard);
+    - ``param_fsdp``: ZeRO-style pp x fsdp composition — stage weights
+      arrive ALSO sharded over ``fsdp_axis`` on their stage_fsdp_dim
+      (shard the TrainState with PIPELINED_BERT_FSDP_RULES or
+      stage_param_spec_fsdp) and are all-gathered inside the shard_map
+      once per step; gradients reduce-scatter back. Persistent memory
+      per device: params + optimizer state / (pp * fsdp).
 
     Without a mesh (or with pp=1) this degenerates to sequentially folding
     the stages — numerically identical, so the same model code runs
@@ -219,9 +283,25 @@ def pipeline(
         lambda a: a.reshape((num_microbatches, mb) + a.shape[1:]), x
     )
 
-    param_specs = jax.tree.map(
-        lambda p: stage_param_spec(p.ndim, axis_name), stacked_params
-    )
+    fsdp_dims = None
+    if param_fsdp:
+        fsdp_size = mesh.shape[fsdp_axis]
+
+        def _dim(p):
+            d = stage_fsdp_dim(p.shape, fsdp_size)
+            return -1 if d is None else d
+
+        fsdp_dims = jax.tree.map(_dim, stacked_params)
+        param_specs = jax.tree.map(
+            lambda p: stage_param_spec_fsdp(
+                p.shape, fsdp_size, axis_name, fsdp_axis
+            ),
+            stacked_params,
+        )
+    else:
+        param_specs = jax.tree.map(
+            lambda p: stage_param_spec(p.ndim, axis_name), stacked_params
+        )
     # Microbatched input: the original batch dim is now dim 1.
     x_specs = jax.tree.map(
         lambda a: P(None, *batch_spec, *([None] * (a.ndim - 2))), xm
@@ -233,6 +313,8 @@ def pipeline(
             stage_fn=stage_fn,
             axis_name=axis_name,
             num_microbatches=num_microbatches,
+            fsdp_dims=fsdp_dims,
+            fsdp_axis=fsdp_axis,
         ),
         mesh=mesh,
         in_specs=(param_specs, x_specs),

@@ -49,10 +49,12 @@ from tpudl.ops.dropout import Dropout
 from tpudl.parallel.pipeline import (
     pipeline,
     stack_pytrees,
+    stage_fsdp_dim,
     stage_param_spec,
 )
 from tpudl.parallel.sharding import (
     Rules,
+    _fsdp_largest_dim,
     active_mesh,
     constrain,
     current_mesh,
@@ -63,6 +65,31 @@ from tpudl.parallel.sharding import (
 #: shard their leading stage dim over pp; io stays replicated.
 PIPELINED_BERT_RULES: Rules = (
     (r"(^|/)stages/", lambda shape: stage_param_spec(len(shape))),
+)
+
+
+def _stage_fsdp_spec(shape):
+    """pp on the stage dim + fsdp on stage_fsdp_dim (the pipeline
+    in_specs' own dim choice — shared function, so the TrainState
+    sharding and the shard_map gather agree leaf-for-leaf;
+    tree_shardings' divisibility clamp mirrors stage_fsdp_dim's
+    size-aware bail-out)."""
+    entries = ["pp"] + [None] * (len(shape) - 1)
+    dim = stage_fsdp_dim(shape)
+    if dim is not None:
+        entries[dim] = "fsdp"
+    return P(*entries)
+
+
+#: strategy="pp+fsdp": stage weights AND their optimizer moments sharded
+#: 1/(pp*fsdp); the io tree (embeddings/pooler/classifier + moments)
+#: fsdp-shards too — embeddings on the vocab dim, kernels via the
+#: standard largest-dim rule (first match wins, so stages/ hits the
+#: pipeline rule before the generic kernel rule).
+PIPELINED_BERT_FSDP_RULES: Rules = (
+    (r"(^|/)stages/", _stage_fsdp_spec),
+    (r"(^|/)io/.*embedding$", P("fsdp", None)),
+    (r"(^|/)io/.*kernel$", _fsdp_largest_dim),
 )
 
 
@@ -80,6 +107,7 @@ class PipelinedBertClassifier:
         cfg: BertConfig,
         num_stages: int,
         num_microbatches: int,
+        param_fsdp: bool = False,
     ):
         if cfg.num_layers % num_stages != 0:
             raise ValueError(
@@ -90,6 +118,11 @@ class PipelinedBertClassifier:
         self.num_stages = num_stages
         self.layers_per_stage = cfg.num_layers // num_stages
         self.num_microbatches = num_microbatches
+        #: pp x fsdp composition (strategy="pp+fsdp"): shard the
+        #: TrainState with PIPELINED_BERT_FSDP_RULES so stage weights +
+        #: optimizer moments live 1/(pp*fsdp); the pipeline all-gathers
+        #: per step and reduce-scatters gradients.
+        self.param_fsdp = param_fsdp
 
     # -- train-stack surface ----------------------------------------------
     def init(self, rng, input_ids, train: bool = False) -> Dict:
@@ -249,7 +282,12 @@ class PipelinedBertClassifier:
                     (x, mask4, key_rows),
                     num_microbatches=m,
                     mesh=mesh,
+                    # fsdp stays a DATA axis (ZeRO semantics): the batch
+                    # splits over (dp, fsdp) while param_fsdp shards the
+                    # WEIGHTS over fsdp too — the all-gather transpose
+                    # reduce-scatters each shard's gradient contribution.
                     batch_spec=P(("dp", "fsdp")),
+                    param_fsdp=self.param_fsdp,
                 )
 
         x = constrain(x, ("dp", "fsdp"), "sp", "tp")

@@ -187,7 +187,11 @@ def strategy_rules(strategy: str) -> Rules:
         from tpudl.parallel.pipelined_bert import PIPELINED_BERT_RULES
 
         return PIPELINED_BERT_RULES
+    if strategy == "pp+fsdp":
+        from tpudl.parallel.pipelined_bert import PIPELINED_BERT_FSDP_RULES
+
+        return PIPELINED_BERT_FSDP_RULES
     raise ValueError(
         f"unknown strategy {strategy!r}; expected dp | fsdp | tp | "
-        f"fsdp+tp | lora | pp"
+        f"fsdp+tp | lora | pp | pp+fsdp"
     )

@@ -1,0 +1,61 @@
+"""Module-level worker payloads for the driver's multi-process dry run
+(__graft_entry__.dryrun_multichip) — importable by reference from
+TpuDistributor-spawned subprocesses, like tests/dist_helpers.py but
+shipped in the package so the dry run has no test-tree dependency.
+"""
+
+from __future__ import annotations
+
+
+def converter_fed_train_smoke(data_dir: str, local_batch: int = 16):
+    """One epoch of converter-fed pjit training inside a spawned JAX
+    process: this rank reads ITS disjoint Parquet shard, feeds it through
+    prefetch_to_device's jax.make_array_from_process_local_data path into
+    the compiled step, and returns (process_index, process_count,
+    global_device_count, losses). Every rank must report identical global
+    losses — the global-array contract across the process boundary."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.data.converter import make_converter, prefetch_to_device
+    from tpudl.data.datasets import normalize_cifar_batch
+    from tpudl.models.resnet import ResNetTiny
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        fit,
+        make_classification_train_step,
+    )
+
+    conv = make_converter(data_dir)
+    mesh = make_mesh(MeshSpec(dp=-1))
+    model = ResNetTiny(num_classes=10)
+    state = create_train_state(
+        jax.random.key(0), model, jnp.zeros((1, 32, 32, 3)), optax.sgd(0.05)
+    )
+    step = compile_step(make_classification_train_step(), mesh, state, None)
+
+    batches = conv.make_batch_iterator(
+        local_batch,
+        epochs=1,
+        shuffle=False,
+        drop_last=True,
+        transform=normalize_cifar_batch,
+    )
+    losses = []
+    state, metrics, info = fit(
+        step,
+        state,
+        prefetch_to_device(batches, mesh=mesh),
+        jax.random.key(1),
+        log_every=1,
+        logger=lambda i, m: losses.append(m["loss"]),
+    )
+    return (
+        jax.process_index(),
+        jax.process_count(),
+        jax.device_count(),
+        losses,
+    )
