@@ -191,3 +191,81 @@ def test_bert_with_ulysses_impl(sp_mesh):
     with active_mesh(sp_mesh):
         got = build("ulysses").apply({"params": params}, ids, mask, train=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4)
+
+
+class TestUlyssesDropout:
+    """Round-4: attention dropout under ulysses SP — exact per-head
+    semantics on the post-all-to-all fully-local sequences, with each
+    mesh slot folding its position into the key (independent masks).
+    CPU path: local_impl='reference' (the jax.random low-width-bits
+    masks, which run everywhere)."""
+
+    def _qkv(self, seed=0, b=2, s=32, h=4, d=16):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(  # noqa: E731
+            rng.normal(size=(b, s, h, d)), jnp.float32
+        )
+        return mk(), mk(), mk()
+
+    def test_deterministic_and_varies_by_key(self, sp_mesh):
+        from tpudl.ops.ulysses import ulysses_attention
+
+        q, k, v = self._qkv()
+        with active_mesh(sp_mesh):
+            kwargs = dict(
+                mesh=sp_mesh, local_impl="reference", dropout_rate=0.2,
+            )
+            o1 = ulysses_attention(
+                q, k, v, dropout_rng=jax.random.key(5), **kwargs
+            )
+            o2 = ulysses_attention(
+                q, k, v, dropout_rng=jax.random.key(5), **kwargs
+            )
+            o3 = ulysses_attention(
+                q, k, v, dropout_rng=jax.random.key(6), **kwargs
+            )
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        assert not np.array_equal(np.asarray(o1), np.asarray(o3))
+
+    def test_expectation_matches_base(self, sp_mesh):
+        """Mean over keys approaches the no-dropout output — catches
+        rescale and mask-correlation errors in one statistical check."""
+        from tpudl.ops.ulysses import ulysses_attention
+
+        q, k, v = self._qkv(seed=1)
+        with active_mesh(sp_mesh):
+            base = ulysses_attention(
+                q, k, v, mesh=sp_mesh, local_impl="reference"
+            )
+            f = jax.jit(
+                lambda r: ulysses_attention(
+                    q, k, v, mesh=sp_mesh, local_impl="reference",
+                    dropout_rate=0.2, dropout_rng=r,
+                )
+            )
+            acc = jnp.zeros_like(base)
+            n = 64
+            for i in range(n):
+                acc = acc + f(jax.random.key(100 + i))
+        err = float(jnp.mean(jnp.abs(acc / n - np.asarray(base))))
+        assert err < 0.05, err
+
+    def test_attend_dispatch_and_mask(self, sp_mesh):
+        """attend('ulysses', dropout) works with a padding mask; rng
+        required; ring still refuses."""
+        from tpudl.ops.attention import attend
+
+        q, k, v = self._qkv(seed=2)
+        pad = np.ones((2, 32), np.int32)
+        pad[:, 28:] = 0
+        with active_mesh(sp_mesh):
+            out = attend(
+                q, k, v, mask=jnp.asarray(pad), implementation="ulysses",
+                dropout_rate=0.2, dropout_rng=jax.random.key(0),
+            )
+        assert np.isfinite(np.asarray(out)).all()
+        with pytest.raises(ValueError, match="dropout_rng"):
+            attend(q, k, v, implementation="ulysses", dropout_rate=0.2)
+        with pytest.raises(ValueError, match="ulysses"):
+            attend(q, k, v, implementation="ring", dropout_rate=0.2,
+                   dropout_rng=jax.random.key(0))

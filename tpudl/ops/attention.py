@@ -135,6 +135,8 @@ def unmeshed_attention(
     mask: Optional[jax.Array],
     causal: bool,
     scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Single-device degenerate path for the sequence-parallel
     implementations: reference attention with the kv-validity mask and the
@@ -143,7 +145,7 @@ def unmeshed_attention(
         mask = normalize_kv_mask(mask, q.shape[0], k.shape[1])
     return dot_product_attention(
         q, k, v, combine_kv_causal_mask(mask, q.shape[1], k.shape[1], causal),
-        scale=scale,
+        scale=scale, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
     )
 
 
@@ -177,10 +179,11 @@ def attend(
                     local_impl parameter pins either).
 
     Attention-probability dropout is supported by the reference, fused,
-    and flash implementations (the Pallas kernels draw in-kernel from the
-    TPU hardware PRNG); ring/ulysses reject a nonzero rate rather than
-    silently dropping it (fine-tune with attention_dropout=0 on those
-    paths).
+    flash, AND ulysses implementations (the Pallas kernels draw in-kernel
+    from the TPU hardware PRNG; ulysses folds each mesh slot's position
+    into the key and applies per-head dropout on its fully-local
+    sequences). Ring rejects a nonzero rate rather than silently dropping
+    it — its softmax is distributed across sp shards.
     """
     if dropout_rate > 0.0 and dropout_rng is None:
         raise ValueError(
@@ -233,17 +236,24 @@ def attend(
             q, k, v, mask=mask, causal=causal,
             dropout_rate=dropout_rate, dropout_rng=dropout_rng,
         )
+    if implementation == "ulysses":
+        # Exact dropout under SP: post-all-to-all every head is fully
+        # local, so the per-head masks are plain BERT/Llama semantics.
+        from tpudl.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, mask=mask, causal=causal,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        )
     if dropout_rate > 0.0:
         raise ValueError(
             f"attention-probability dropout is not supported by the "
-            f"{implementation!r} implementation; set attention_dropout=0.0"
+            f"{implementation!r} implementation (ring attention's softmax "
+            f"is distributed across sp shards); set attention_dropout=0.0 "
+            f"or use implementation='ulysses'"
         )
     if implementation == "ring":
         from tpudl.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, mask=mask, causal=causal)
-    if implementation == "ulysses":
-        from tpudl.ops.ulysses import ulysses_attention
-
-        return ulysses_attention(q, k, v, mask=mask, causal=causal)
     raise ValueError(f"unknown attention implementation: {implementation!r}")

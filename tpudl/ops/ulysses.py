@@ -36,12 +36,36 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpudl.runtime.mesh import AXIS_SEQ, BATCH_AXES, AXIS_TENSOR
 
 
-def _ulysses_local(q, k, v, kvm=None, *, axis_name, causal, scale, local_impl):
+def _device_dropout_rng(key_data, key_impl, fold_axes):
+    """Per-device dropout key inside the shard_map: fold the device's
+    linear position over ``fold_axes`` ((name, size) pairs — the axes
+    whose slots hold DIFFERENT examples/heads after the all-to-all, as
+    computed by ulysses_attention) into the caller's key; identical local
+    masks would otherwise correlate dropout across those slots. Axes the
+    output is REPLICATED over (e.g. tp when heads aren't tp-sharded) must
+    NOT be folded — divergent values on a replicated-out axis would be
+    assembled inconsistently."""
+    rng = jax.random.wrap_key_data(key_data, impl=key_impl)
+    idx = 0
+    for name, size in fold_axes:
+        idx = idx * size + jax.lax.axis_index(name)
+    return jax.random.fold_in(rng, idx)
+
+
+def _ulysses_local(q, k, v, kvm=None, key_data=None, *, axis_name, causal,
+                   scale, local_impl, dropout_rate=0.0, key_impl=None,
+                   fold_axes=()):
     """Per-device body. q/k/v: [B, S/n, H_local, D] (H_local = H/tp·... the
     heads remaining on this device's tp slice); kvm: [B, S] full-sequence
     kv-validity row (replicated over sp), or None when the caller passed
     no mask — kept None so flash takes its maskless codegen path (no
-    per-tile kv-row traffic on the unmasked long-context hot path)."""
+    per-tile kv-row traffic on the unmasked long-context hot path).
+
+    Dropout: after the all-to-all each device holds FULL sequences for
+    its head slice, so attention-probability dropout is exact BERT/Llama
+    semantics applied locally (in-kernel hardware PRNG under flash;
+    jax.random masks under reference) — the property ring attention
+    lacks (its softmax is distributed, so it still rejects dropout)."""
     from tpudl.ops.attention import dot_product_attention
 
     n = jax.lax.psum(1, axis_name)
@@ -61,13 +85,20 @@ def _ulysses_local(q, k, v, kvm=None, *, axis_name, causal, scale, local_impl):
     if n > 1:
         q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
 
+    rng = None
+    if dropout_rate > 0.0:
+        rng = _device_dropout_rng(key_data, key_impl, fold_axes)
+
     if local_impl == "flash":
         # Pallas flash kernel on the head slice: peak memory stays linear
         # in S instead of materializing the [B, H/n, S, S] score tensor —
         # the whole point of the long-context path ulysses serves.
         from tpudl.ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, mask=kvm, causal=causal, scale=scale)
+        out = flash_attention(
+            q, k, v, mask=kvm, causal=causal, scale=scale,
+            dropout_rate=dropout_rate, dropout_rng=rng,
+        )
     else:
         from tpudl.ops.attention import combine_kv_causal_mask
 
@@ -78,6 +109,8 @@ def _ulysses_local(q, k, v, kvm=None, *, axis_name, causal, scale, local_impl):
                 q.shape[1], k.shape[1], causal,
             ),
             scale=scale,
+            dropout_rate=dropout_rate,
+            dropout_rng=rng,
         )
     if n > 1:
         out = heads_to_seq(out)
@@ -94,6 +127,8 @@ def ulysses_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_SEQ,
     local_impl: Optional[str] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequence-parallel attention on [B, S, H, D] via all-to-all
     (tpudl.ops.attention contract; Sq == Skv — one shared sequence axis).
@@ -107,6 +142,13 @@ def ulysses_attention(
     kernel — memory linear in S, the long-context default on TPU) or
     "reference" (einsum — exact tpudl.ops.attention numerics, the default
     on CPU where the kernel would run interpreted). None = by backend.
+
+    ``dropout_rate`` > 0 (round 4): attention-probability dropout with
+    exact semantics — after the all-to-all every head attends its full
+    sequence locally, so this is plain per-head dropout; each mesh slot
+    folds its position into ``dropout_rng`` for independent masks. The
+    flash body draws in-kernel (TPU hardware PRNG); the reference body
+    uses the low-width-bits jax.random path, which also runs on CPU.
     """
     from tpudl.ops.attention import normalize_kv_mask, unmeshed_attention
     from tpudl.parallel.sharding import current_mesh
@@ -125,14 +167,23 @@ def ulysses_attention(
             f"local_impl must be 'flash' or 'reference', got {local_impl!r}"
         )
 
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires a dropout_rng")
+
     if mesh is None:
         mesh = current_mesh()
     if mesh is None:
         if local_impl == "flash":
             from tpudl.ops.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, mask=mask, causal=causal, scale=scale)
-        return unmeshed_attention(q, k, v, mask, causal, scale)
+            return flash_attention(
+                q, k, v, mask=mask, causal=causal, scale=scale,
+                dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+            )
+        return unmeshed_attention(
+            q, k, v, mask, causal, scale,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        )
 
     b, s, h, d = q.shape
     if k.shape[1] != s:
@@ -155,26 +206,48 @@ def ulysses_attention(
         scale = d ** -0.5
 
     batch = tuple(a for a in BATCH_AXES if mesh.shape[a] > 1) or None
-    heads = AXIS_TENSOR if h % max(n_tp, 1) == 0 and n_tp > 1 else None
+    heads_sharded = h % max(n_tp, 1) == 0 and n_tp > 1
+    heads = AXIS_TENSOR if heads_sharded else None
     qkv_spec = P(batch, axis_name, heads, None)
+    key_impl = (
+        jax.random.key_impl(dropout_rng) if dropout_rate > 0.0 else None
+    )
+    # Axes whose slots see distinct data and so need distinct dropout
+    # masks: the sharded batch axes, the all-to-all axis itself, and tp
+    # ONLY when heads are genuinely tp-sharded (folding an axis the
+    # output is replicated over would assemble inconsistent shards).
+    fold_axes = tuple(
+        (a, mesh.shape[a]) for a in (BATCH_AXES if batch else ())
+        if mesh.shape[a] > 1
+    ) + ((axis_name, n_sp),) + (
+        ((AXIS_TENSOR, n_tp),) if heads_sharded else ()
+    )
     body = partial(_ulysses_local, axis_name=axis_name, causal=causal,
-                   scale=scale, local_impl=local_impl)
-    if mask is None:
-        # No kvm operand at all: flash keeps its maskless codegen path.
-        fn = jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec),
-            out_specs=qkv_spec,
-            check_vma=False,
-        )
-        return fn(q, k, v)
-    kvm = normalize_kv_mask(mask, b, s, impl="ulysses_attention")
+                   scale=scale, local_impl=local_impl,
+                   dropout_rate=dropout_rate, key_impl=key_impl,
+                   fold_axes=fold_axes)
+
+    operands = [q, k, v]
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    if mask is not None:
+        operands.append(normalize_kv_mask(mask, b, s, impl="ulysses_attention"))
+        in_specs.append(P(batch, None))
+    if dropout_rate > 0.0:
+        # Key data rides as a replicated raw-uint32 operand (key ARRAYS
+        # don't thread shard_map specs); each device re-wraps and folds
+        # its mesh position in (_device_dropout_rng).
+        operands.append(jax.random.key_data(dropout_rng))
+        in_specs.append(P(*([None] * jax.random.key_data(dropout_rng).ndim)))
+        if mask is None:
+            # kvm is positional before key_data in the body signature —
+            # wrap the ONE bound partial rather than rebuilding it.
+            inner = body
+            body = lambda q_, k_, v_, kd_: inner(q_, k_, v_, None, kd_)  # noqa: E731
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch, None)),
+        in_specs=tuple(in_specs),
         out_specs=qkv_spec,
         check_vma=False,
     )
-    return fn(q, k, v, kvm)
+    return fn(*operands)

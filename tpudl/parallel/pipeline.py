@@ -67,10 +67,11 @@ def stage_fsdp_dim(
 
 
 def stage_param_spec_fsdp(
-    shape, fsdp_size: int, axis_name: str = AXIS_PIPE,
+    shape, fsdp_size: Optional[int], axis_name: str = AXIS_PIPE,
     fsdp_axis: str = "fsdp",
 ) -> P:
-    """stage_param_spec composed with fsdp sharding on stage_fsdp_dim."""
+    """stage_param_spec composed with fsdp sharding on stage_fsdp_dim
+    (fsdp_size=None = rules path: divisibility left to the clamp)."""
     entries = [axis_name] + [None] * (len(shape) - 1)
     dim = stage_fsdp_dim(shape, fsdp_size)
     if dim is not None:

@@ -49,8 +49,8 @@ from tpudl.ops.dropout import Dropout
 from tpudl.parallel.pipeline import (
     pipeline,
     stack_pytrees,
-    stage_fsdp_dim,
     stage_param_spec,
+    stage_param_spec_fsdp,
 )
 from tpudl.parallel.sharding import (
     Rules,
@@ -69,16 +69,12 @@ PIPELINED_BERT_RULES: Rules = (
 
 
 def _stage_fsdp_spec(shape):
-    """pp on the stage dim + fsdp on stage_fsdp_dim (the pipeline
-    in_specs' own dim choice — shared function, so the TrainState
-    sharding and the shard_map gather agree leaf-for-leaf;
-    tree_shardings' divisibility clamp mirrors stage_fsdp_dim's
-    size-aware bail-out)."""
-    entries = ["pp"] + [None] * (len(shape) - 1)
-    dim = stage_fsdp_dim(shape)
-    if dim is not None:
-        entries[dim] = "fsdp"
-    return P(*entries)
+    """pp on the stage dim + fsdp on stage_fsdp_dim, via the SAME
+    constructor the pipeline's shard_map in_specs use
+    (stage_param_spec_fsdp) — fsdp_size=None defers divisibility to
+    tree_shardings' clamp, which bails out under the same condition as
+    stage_fsdp_dim's size-aware path."""
+    return stage_param_spec_fsdp(shape, None)
 
 
 #: strategy="pp+fsdp": stage weights AND their optimizer moments sharded
