@@ -96,6 +96,9 @@ def main():
                         help="MetricLogger directory (JSONL + TensorBoard)")
     parser.add_argument("--eval-steps", type=int, default=8,
                         help="held-out eval batches after training (0 = off)")
+    parser.add_argument("--mfu-compiled", action="store_true",
+                        help="exact compiled-cost FLOPs for an MFU print "
+                        "(pays a second full XLA compile at the end)")
     args = parser.parse_args()
     if (args.materialize or args.ingest) and not args.data_dir:
         parser.error("--materialize/--ingest require --data-dir")
@@ -126,20 +129,34 @@ def main():
           f"batch {batch_size} (accum {accum}), image {cfg.image_size}, "
           f"strategy {cfg.strategy}, mesh {dict(mesh.shape)}")
     rules = strategy_rules(cfg.strategy)
+    # Parquet-fed runs ship uint8 over the host->device link and
+    # normalize ON DEVICE (fused into the first conv) — 4x less transfer
+    # (tpudl.data.augment.device_normalize). The synthetic stream is
+    # already f32.
+    from tpudl.data.augment import (
+        CIFAR10_MEAN,
+        CIFAR10_STD,
+        IMAGENET_MEAN,
+        IMAGENET_STD,
+        device_normalize,
+    )
+
+    norm_mean = CIFAR10_MEAN if is_cifar else IMAGENET_MEAN
+    norm_std = CIFAR10_STD if is_cifar else IMAGENET_STD
+    input_transform = (
+        device_normalize(norm_mean, norm_std) if args.data_dir else None
+    )
     step = compile_step(
         make_classification_train_step(
-            cfg.label_smoothing, accum_steps=accum
+            cfg.label_smoothing, accum_steps=accum,
+            input_transform=input_transform,
         ),
         mesh, state, rules,
     )
 
     warmup_steps = 2
     if args.data_dir:
-        from tpudl.data.augment import (
-            IMAGENET_MEAN,
-            IMAGENET_STD,
-            BatchAugmenter,
-        )
+        from tpudl.data.augment import BatchAugmenter
         from tpudl.data.datasets import (
             materialize_cifar10_like,
             materialize_imagenet_like,
@@ -166,25 +183,23 @@ def main():
         else:
             conv = make_converter(args.data_dir)
         train_conv, eval_conv = split_train_eval(conv)
-        # Standard training augmentation (pad+random crop + flip +
-        # normalize), fused in the native C++ kernel when available
-        # (tpudl/native/augment.cpp; numpy fallback otherwise).
-        norm = {} if is_cifar else {
-            "mean": IMAGENET_MEAN, "std": IMAGENET_STD
-        }
+        # Standard training augmentation (pad+random crop + flip) in
+        # uint8 on the host; normalization happens on device
+        # (input_transform above).
         augment = BatchAugmenter(
             crop=(cfg.image_size, cfg.image_size),
-            pad=4 if is_cifar else 8, seed=cfg.seed, **norm,
+            pad=4 if is_cifar else 8, seed=cfg.seed,
+            mean=norm_mean, std=norm_std, normalize=False,
         )
         raw = train_conv.make_batch_iterator(
             batch_size, epochs=None, shuffle=True, seed=cfg.seed,
             transform=augment,
         )
 
-        # Eval path: SAME normalization as training, no crop/flip.
+        # Eval path: SAME device normalization, center crop, no flip.
         eval_augment = BatchAugmenter(
             crop=(cfg.image_size, cfg.image_size), pad=0, hflip=False,
-            train=False, **norm,
+            train=False, mean=norm_mean, std=norm_std, normalize=False,
         )
 
         def _eval_normalize(b):
@@ -273,7 +288,8 @@ def main():
 
     if args.eval_steps:
         eval_step = compile_step(
-            make_classification_eval_step(), mesh, state, rules, has_rng=False
+            make_classification_eval_step(input_transform=input_transform),
+            mesh, state, rules, has_rng=False
         )
         eval_metrics = evaluate(
             eval_step, state, eval_raw(), num_steps=args.eval_steps
@@ -288,26 +304,41 @@ def main():
                    {f"eval_{k}": v for k, v in eval_metrics.items()})
     if logger:
         logger.close()
+    if info["steps"] == 0:
+        # fit() saw zero batches so its final checkpoint never fired;
+        # warmup may still have trained wsteps steps — save them or a
+        # resume loop would retrain them forever.
+        if ckpt_mgr is not None and wsteps:
+            ckpt_mgr.save(int(state.step), state)
+            ckpt_mgr.wait_until_finished()
+        print(
+            f"trained {wsteps} warmup step(s) only — no steady-state "
+            f"throughput window to report" if wsteps else
+            "no training steps this run (budget already met)"
+        )
+        return
     images_per_sec = batch_size * info["steps"] / max(info["seconds"], 1e-9)
     line = (
         f"throughput ~{images_per_sec:.0f} images/sec over {info['steps']} "
         f"steady-state steps (compile + warmup excluded)"
     )
-    # MFU from the compiled executable's FLOPs (SURVEY.md §5.5).
-    try:
-        example = next(synthetic_classification_batches(
-            batch_size, image_shape=(cfg.image_size, cfg.image_size, 3),
-            num_classes=cfg.num_classes, num_batches=1,
-        ))
-        flops = compiled_flops(step.jitted.lower(state, example, rng))
-        if flops:
-            step_seconds = info["seconds"] / max(info["steps"], 1)
-            line += (
-                f"; MFU ~{100 * mfu(flops, step_seconds, jax.device_count()):.1f}%"
-                f" (peak {device_peak_flops() / 1e12:.0f} TFLOP/s/chip)"
-            )
-    except Exception:
-        pass
+    # MFU from the compiled executable's FLOPs (SURVEY.md §5.5) — opt-in:
+    # lower().compile() pays a SECOND full XLA compile.
+    if args.mfu_compiled:
+        try:
+            example = next(synthetic_classification_batches(
+                batch_size, image_shape=(cfg.image_size, cfg.image_size, 3),
+                num_classes=cfg.num_classes, num_batches=1,
+            ))
+            flops = compiled_flops(step.jitted.lower(state, example, rng))
+            if flops:
+                step_seconds = info["seconds"] / max(info["steps"], 1)
+                line += (
+                    f"; MFU ~{100 * mfu(flops, step_seconds, jax.device_count()):.1f}%"
+                    f" (peak {device_peak_flops() / 1e12:.0f} TFLOP/s/chip)"
+                )
+        except Exception:
+            pass
     print(line)
 
 

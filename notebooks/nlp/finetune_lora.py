@@ -74,6 +74,16 @@ def main():
     parser.add_argument("--materialize", action="store_true",
                         help="force re-materialization/re-tokenization of "
                         "--data-dir")
+    parser.add_argument("--dtype", type=str, default="f32",
+                        choices=["f32", "bf16"],
+                        help="compute dtype (bf16 for real-scale runs; "
+                        "f32 default keeps the tiny-model CI exact)")
+    parser.add_argument("--attn", type=str, default="reference",
+                        choices=["reference", "fused", "flash"],
+                        help="attention implementation: 'fused'/'flash' "
+                        "use the Pallas kernels (flash streams any length "
+                        "with in-kernel dropout — the seq-2048 configs[4] "
+                        "path)")
     parser.add_argument(
         "--hf-checkpoint", type=str, default=None,
         help="local HuggingFace Llama checkpoint directory: base weights "
@@ -88,7 +98,11 @@ def main():
         parser.error("--ingest feeds the raw-text vertical: add --text-data")
 
     cfg = get_config("llama3_8b_lora", model=args.model)
-    model = build_model(cfg.model, cfg.num_classes, dtype=jnp.float32)
+    model = build_model(
+        cfg.model, cfg.num_classes,
+        dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+        attention_impl=args.attn,
+    )
 
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
     params = model.init(jax.random.key(cfg.seed), sample)["params"]

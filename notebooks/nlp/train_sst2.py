@@ -114,6 +114,10 @@ def main():
                         help="MetricLogger directory (JSONL + TensorBoard)")
     parser.add_argument("--eval-steps", type=int, default=8,
                         help="held-out eval batches after training (0 = off)")
+    parser.add_argument("--mfu-compiled", action="store_true",
+                        help="exact compiled-cost FLOPs for the MFU print "
+                        "(pays a second full XLA compile; default: 6ND "
+                        "estimate)")
     args = parser.parse_args()
     if (args.materialize or args.text_data) and not args.data_dir:
         parser.error("--materialize/--text-data require --data-dir")
@@ -368,17 +372,35 @@ def main():
     if logger:
         logger.close()
 
+    if info["steps"] == 0:
+        # fit() saw zero batches, so ITS final checkpoint never fired —
+        # but the warmup loop may still have trained wsteps optimizer
+        # steps (a resume landing within warmup_steps of the budget).
+        # Without this save those steps would be retrained forever.
+        if ckpt_mgr is not None and wsteps:
+            ckpt_mgr.save(int(state.step), state)
+            ckpt_mgr.wait_until_finished()
+        if wsteps:
+            print(f"trained {wsteps} warmup step(s) only — no "
+                  f"steady-state throughput window to report")
+        else:
+            print("no training steps this run (budget already met)")
+        return
     samples_per_sec = batch_size * info["steps"] / info["seconds"]
-    # FLOPs from the compiled executable; 6ND transformer estimate as fallback.
+    # 6ND transformer estimate by default (the BASELINE.md basis);
+    # --mfu-compiled opts into exact compiled-cost FLOPs, which pays a
+    # SECOND full XLA compile via lower().compile() — minutes at
+    # BERT-large scale, not worth it on every training run.
     flops = None
-    try:
-        example = next(synthetic_token_batches(
-            batch_size, seq_len=seq_len, vocab_size=model.cfg.vocab_size,
-            num_batches=1,
-        ))
-        flops = compiled_flops(step.jitted.lower(state, example, rng))
-    except Exception:
-        pass
+    if args.mfu_compiled:
+        try:
+            example = next(synthetic_token_batches(
+                batch_size, seq_len=seq_len, vocab_size=model.cfg.vocab_size,
+                num_batches=1,
+            ))
+            flops = compiled_flops(step.jitted.lower(state, example, rng))
+        except Exception:
+            pass
     if flops is None:
         flops = transformer_train_flops(num_params, batch_size * seq_len)
     step_seconds = info["seconds"] / max(info["steps"], 1)

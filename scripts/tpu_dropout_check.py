@@ -202,6 +202,33 @@ def main() -> int:
                     dropout_rate=0.1, dropout_rng=frng)
     check("attend seq-2048 dropout via flash finite",
           bool(jnp.all(jnp.isfinite(o_long.astype(jnp.float32)))))
+
+    # ulysses dropout with the FLASH local body on the real chip (the CPU
+    # tier covers local_impl='reference'): single-device degenerate path
+    # (no mesh on one chip) must be deterministic per key and match the
+    # expectation of the base output.
+    from tpudl.ops.ulysses import ulysses_attention
+
+    qs2 = jax.random.normal(jax.random.key(30), (2, 256, 4, 64), jnp.float32)
+    ks2 = jax.random.normal(jax.random.key(31), (2, 256, 4, 64), jnp.float32)
+    vs2 = jax.random.normal(jax.random.key(32), (2, 256, 4, 64), jnp.float32)
+    u1 = ulysses_attention(qs2, ks2, vs2, local_impl="flash",
+                           dropout_rate=0.2, dropout_rng=frng)
+    u2 = ulysses_attention(qs2, ks2, vs2, local_impl="flash",
+                           dropout_rate=0.2, dropout_rng=frng)
+    check("ulysses flash dropout deterministic per key",
+          bool(jnp.all(u1 == u2)))
+    ubase = ulysses_attention(qs2, ks2, vs2, local_impl="flash")
+    uf = jax.jit(lambda r: ulysses_attention(
+        qs2, ks2, vs2, local_impl="flash", dropout_rate=0.2, dropout_rng=r
+    ))
+    uacc = jnp.zeros_like(ubase)
+    un = 64
+    for i in range(un):
+        uacc = uacc + uf(jax.random.key(300 + i))
+    uerr = float(jnp.mean(jnp.abs(uacc / un - ubase)))
+    check(f"ulysses flash E[dropout out] ~ base (mean_abs {uerr:.4f})",
+          uerr < 0.05)
     return 1 if failures else 0
 
 

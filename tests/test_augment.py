@@ -173,3 +173,43 @@ def test_augmenter_rejects_bad_input():
         BatchAugmenter(backend="numpy", mean=(0.5,), std=(0.5,))(
             np.zeros((2, 32, 32, 3), np.uint8)
         )
+
+def test_device_normalize_matches_host_normalize():
+    """uint8 host crop/flip + device_normalize == the host-normalized f32
+    path bit-for-tolerance — the two placements must train identically
+    (the device path ships 4x fewer bytes over the host->device link)."""
+    import jax
+
+    from tpudl.data.augment import BatchAugmenter, device_normalize
+
+    rng = np.random.default_rng(3)
+    images = rng.integers(0, 256, size=(8, 40, 40, 3)).astype(np.uint8)
+    batch = {"image": images, "label": np.arange(8)}
+
+    host = BatchAugmenter(crop=(32, 32), pad=4, seed=7, backend="numpy")
+    dev = BatchAugmenter(crop=(32, 32), pad=4, seed=7, backend="numpy",
+                         normalize=False)
+    want = host(dict(batch))["image"]
+    raw = dev(dict(batch))["image"]
+    assert raw.dtype == np.uint8
+    got = np.asarray(
+        jax.jit(device_normalize())({"image": raw, "label": batch["label"]})[
+            "image"
+        ]
+    )
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    # eval (center-crop) path too
+    host_e = BatchAugmenter(crop=(32, 32), pad=0, hflip=False, train=False,
+                            backend="numpy")
+    dev_e = BatchAugmenter(crop=(32, 32), pad=0, hflip=False, train=False,
+                           backend="numpy", normalize=False)
+    want_e = host_e(dict(batch))["image"]
+    raw_e = dev_e(dict(batch))["image"]
+    assert raw_e.dtype == np.uint8
+    got_e = np.asarray(
+        jax.jit(device_normalize())(
+            {"image": raw_e, "label": batch["label"]}
+        )["image"]
+    )
+    np.testing.assert_allclose(got_e, want_e, atol=1e-6)

@@ -162,6 +162,7 @@ class Converter:
         columns: Optional[Sequence[str]] = None,
         shuffle_buffer: int = 8192,
         transform: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]] = None,
+        num_reader_threads: int = 4,
     ) -> Iterator[Dict[str, np.ndarray]]:
         """Yield batches for this process's shard.
 
@@ -174,6 +175,13 @@ class Converter:
 
         ``transform`` (e.g. tpudl.data.augment.BatchAugmenter) is applied
         to each assembled batch on the host, before device transfer.
+
+        ``num_reader_threads`` parallelizes Parquet row-group read+decode
+        (the Petastorm reader-pool analog): pyarrow releases the GIL, so
+        a small pool overlaps IO and decode while chunk ORDER is
+        preserved (a bounded window of in-flight futures) — iteration
+        order and sharding are bit-identical to the single-threaded path
+        at any thread count. 1 disables.
         """
         if shard_index is None or num_shards is None:
             import jax
@@ -194,13 +202,57 @@ class Converter:
                 drop_last,
                 columns,
                 shuffle_buffer,
+                num_reader_threads,
             )
             if transform is not None:
                 batches = map(transform, batches)
             yield from batches
             epoch += 1
 
-    def _shard_chunks(self, rng, shard_index, num_shards, columns):
+    def _decoded_groups(self, path, rgs, cols, workers, pf=None):
+        """Read+decode the given row groups of one file, in order.
+
+        workers > 1 keeps a bounded window of futures in flight; each
+        WORKER holds one thread-local ParquetFile handle (pq handles
+        aren't guaranteed thread-safe, and re-opening per group would
+        re-parse the footer — which scales with row-group count — once
+        per 32-row group on the ImageNet layout this path exists for).
+        Results stream back in submission order, so downstream
+        sharding/shuffle see the exact single-threaded sequence.
+        """
+        if workers <= 1 or len(rgs) <= 1:
+            if pf is None:
+                pf = pq.ParquetFile(path)
+            for rg in rgs:
+                yield _decode_table(pf.read_row_group(rg, columns=cols))
+            return
+
+        import collections
+        import itertools
+        from concurrent.futures import ThreadPoolExecutor
+
+        local = threading.local()
+
+        def task(rg):
+            handle = getattr(local, "pf", None)
+            if handle is None:
+                handle = local.pf = pq.ParquetFile(path)
+            return _decode_table(handle.read_row_group(rg, columns=cols))
+
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            it = iter(rgs)
+            futs: "collections.deque" = collections.deque()
+            for rg in itertools.islice(it, workers + 2):
+                futs.append(ex.submit(task, rg))
+            while futs:
+                chunk = futs.popleft().result()
+                nxt = next(it, None)
+                if nxt is not None:
+                    futs.append(ex.submit(task, nxt))
+                yield chunk
+
+    def _shard_chunks(self, rng, shard_index, num_shards, columns,
+                      num_reader_threads=1):
         """Stream this shard's rows file-by-file, row group by row group
         (never a whole file in memory — ImageNet-scale shards stay bounded
         by the Parquet row-group size).
@@ -220,17 +272,27 @@ class Converter:
             lo, hi = self._file_range(fi, pf.metadata.num_rows)
             quota = (hi - lo) // num_shards  # equal across shards
             taken = 0
-            offset = 0
-            for rg in range(pf.metadata.num_row_groups):
-                m = pf.metadata.row_group(rg).num_rows
-                if offset + m <= lo or offset >= hi:
-                    # Whole group outside the row window: skip the Parquet
-                    # read entirely (the holdout of a single-file split
-                    # would otherwise decode ~the whole file per epoch).
-                    offset += m
-                    continue
-                table = pf.read_row_group(rg, columns=cols)
-                data = _decode_table(table)
+            # Plan the row groups first (metadata only): groups fully
+            # outside the row window never pay a Parquet read (the
+            # holdout of a single-file split would otherwise decode ~the
+            # whole file per epoch); the rest stream through the decode
+            # pool in order.
+            group_sizes = [
+                pf.metadata.row_group(rg).num_rows
+                for rg in range(pf.metadata.num_row_groups)
+            ]
+            offsets = np.concatenate([[0], np.cumsum(group_sizes)])
+            wanted = [
+                (rg, int(offsets[rg]))
+                for rg, m in enumerate(group_sizes)
+                if not (offsets[rg] + m <= lo or offsets[rg] >= hi)
+            ]
+            chunks = self._decoded_groups(
+                self.files[fi], [rg for rg, _ in wanted], cols,
+                num_reader_threads, pf=pf,
+            )
+            for (rg, offset), data in zip(wanted, chunks):
+                m = group_sizes[rg]
                 # Global in-file positions of this group's rows; keep only
                 # the converter's row window, then round-robin WITHIN the
                 # window so two converters over disjoint windows of the
@@ -238,7 +300,6 @@ class Converter:
                 pos = offset + np.arange(m)
                 local = np.arange(m)[(pos >= lo) & (pos < hi)]
                 sel = local[(offset + local - lo) % num_shards == shard_index]
-                offset += m
                 if taken + len(sel) > quota:
                     sel = sel[: quota - taken]
                 taken += len(sel)
@@ -254,15 +315,28 @@ class Converter:
         drop_last,
         columns,
         shuffle_buffer,
+        num_reader_threads=1,
     ):
         """Assemble batches from the chunk stream. With shuffle on, rows
         pool into a `shuffle_buffer`-row buffer that is permuted before
         batches are cut — randomization spans row groups and files (a
         sorted/clustered Parquet layout would otherwise yield
-        near-homogeneous batches), with memory bounded by the buffer."""
-        pool: Optional[Dict[str, np.ndarray]] = None
+        near-homogeneous batches), with memory bounded by the buffer.
 
-        def drain(pool, final):
+        Chunks accumulate in a LIST and concatenate once per drain:
+        growing one pool array per chunk would be O(n^2) memcpy — at
+        ImageNet scale (1.2 GB pool, 32-row groups) that measured 115 s
+        before the FIRST batch; this path is ~2 s."""
+        chunks: list = []
+        n_pooled = 0
+
+        def drain(chunks, final):
+            pool = {
+                k: np.concatenate([c[k] for c in chunks])
+                if len(chunks) > 1
+                else chunks[0][k]
+                for k in chunks[0]
+            }
             n_rows = len(next(iter(pool.values())))
             if rng is not None:
                 perm = rng.permutation(n_rows)
@@ -280,21 +354,22 @@ class Converter:
                 rest = None
             return batches, rest
 
-        for chunk in self._shard_chunks(rng, shard_index, num_shards, columns):
-            if pool is None:
-                pool = chunk
-            else:
-                pool = {
-                    k: np.concatenate([pool[k], chunk[k]]) for k in pool
-                }
-            n_rows = len(next(iter(pool.values())))
-            if rng is not None and n_rows < shuffle_buffer:
+        for chunk in self._shard_chunks(
+            rng, shard_index, num_shards, columns, num_reader_threads
+        ):
+            chunks.append(chunk)
+            n_pooled += len(next(iter(chunk.values())))
+            if rng is not None and n_pooled < shuffle_buffer:
                 continue  # keep pooling for shuffle quality
-            if n_rows >= batch_size:
-                batches, pool = drain(pool, final=False)
+            if n_pooled >= batch_size:
+                batches, rest = drain(chunks, final=False)
+                chunks = [rest] if rest is not None else []
+                n_pooled = (
+                    len(next(iter(rest.values()))) if rest is not None else 0
+                )
                 yield from batches
-        if pool is not None:
-            batches, _ = drain(pool, final=True)
+        if chunks:
+            batches, _ = drain(chunks, final=True)
             yield from batches
 
     def steps_per_epoch(self, batch_size: int, num_shards: Optional[int] = None) -> int:

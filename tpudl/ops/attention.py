@@ -245,14 +245,13 @@ def attend(
             q, k, v, mask=mask, causal=causal,
             dropout_rate=dropout_rate, dropout_rng=dropout_rng,
         )
-    if dropout_rate > 0.0:
-        raise ValueError(
-            f"attention-probability dropout is not supported by the "
-            f"{implementation!r} implementation (ring attention's softmax "
-            f"is distributed across sp shards); set attention_dropout=0.0 "
-            f"or use implementation='ulysses'"
-        )
     if implementation == "ring":
+        if dropout_rate > 0.0:
+            raise ValueError(
+                "attention-probability dropout is not supported by ring "
+                "attention (its softmax is distributed across sp shards); "
+                "set attention_dropout=0.0 or use implementation='ulysses'"
+            )
         from tpudl.ops.ring_attention import ring_attention
 
         return ring_attention(q, k, v, mask=mask, causal=causal)

@@ -114,6 +114,7 @@ def make_classification_train_step(
     label_key: str = "label",
     moe_aux_weight: float = 0.0,
     accum_steps: int = 1,
+    input_transform: Optional[Callable[[dict], dict]] = None,
 ) -> Callable:
     """Train step for image/sequence classification models.
 
@@ -139,6 +140,13 @@ def make_classification_train_step(
     over examples (tests/test_accumulation.py asserts parity at f32);
     BatchNorm models update their running stats per microbatch
     sequentially, matching the smaller per-microbatch statistics.
+
+    ``input_transform`` runs INSIDE the compiled step, per microbatch,
+    before the model sees the batch — the device-side preprocessing hook
+    (e.g. tpudl.data.augment.device_normalize: uint8 pixels cross the
+    host->device link, the scale+bias fuses into the first conv). Under
+    accumulation it applies after the microbatch split, so the full
+    batch stays in its compact wire dtype.
     """
     if isinstance(input_keys, str):
         input_keys = (input_keys,)
@@ -159,6 +167,8 @@ def make_classification_train_step(
     def _grads_and_metrics(state, params, stats, batch, dropout_rng):
         """value_and_grad of one (micro)batch; returns (grads, metrics,
         new_stats) with metrics as means over the (micro)batch."""
+        if input_transform is not None:
+            batch = input_transform(batch)
         inputs = tuple(batch[k] for k in input_keys)
 
         def loss_fn(params):
@@ -260,12 +270,16 @@ def make_classification_train_step(
 
 
 def make_classification_eval_step(
-    input_keys: "str | tuple" = ("image",), label_key: str = "label"
+    input_keys: "str | tuple" = ("image",),
+    label_key: str = "label",
+    input_transform: Optional[Callable[[dict], dict]] = None,
 ) -> Callable:
     if isinstance(input_keys, str):
         input_keys = (input_keys,)
 
     def step(state: TrainState, batch: dict):
+        if input_transform is not None:
+            batch = input_transform(batch)
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
