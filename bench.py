@@ -213,10 +213,71 @@ def _bench_bert():
     )
 
 
+def _bench_bert_large():
+    """BERT-large at configs[3]'s declared global batch 256 (4x64
+    gradient-accumulation microbatches — the round-4 lever stack: bf16
+    first moment, state donation, in-step accumulation; BASELINE.md).
+    Lean step counts: this is the secondary metric."""
+    import optax
+
+    from tpudl.data.synthetic import synthetic_token_batches
+    from tpudl.models.bert import BERT_LARGE, BertForSequenceClassification
+    from tpudl.runtime import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        make_classification_train_step,
+    )
+    from tpudl.train.metrics import (
+        device_peak_flops,
+        mfu,
+        transformer_train_flops,
+    )
+
+    batch, accum = 256, 4
+    mesh = make_mesh(MeshSpec(dp=-1))
+    model = BertForSequenceClassification(BERT_LARGE())
+    state = create_train_state(
+        jax.random.key(0),
+        model,
+        jnp.zeros((1, BERT_SEQ), jnp.int32),
+        optax.adamw(2e-5, weight_decay=0.01, mu_dtype=jnp.bfloat16),
+    )
+    n_params = sum(p.size for p in jax.tree.leaves(state.params))
+    step = compile_step(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"), label_key="label",
+            accum_steps=accum,
+        ),
+        mesh,
+        state,
+        None,
+    )
+    data = jax.device_put(
+        next(synthetic_token_batches(batch, seq_len=BERT_SEQ,
+                                     vocab_size=30_522))
+    )
+    rng = jax.random.key(1)
+    flops = transformer_train_flops(n_params, batch * BERT_SEQ)
+    for _ in range(6):
+        state, m = step(state, data, rng)
+    float(m["loss"])
+    start = time.perf_counter()
+    n = 8
+    for _ in range(n):
+        state, m = step(state, data, rng)
+    float(m["loss"])
+    dt = (time.perf_counter() - start) / n
+    return batch / dt / jax.device_count(), mfu(
+        flops, dt, jax.device_count(), device_peak_flops()
+    )
+
+
 def main():
     bert_sps, bert_mfu = _bench_bert()
     resnet_ips = _bench_resnet()
     resnet50_ips = _bench_resnet50()
+    bl_sps, bl_mfu = _bench_bert_large()
 
     vs_baseline = (
         bert_sps / BASELINE_BERT_SAMPLES_PER_SEC
@@ -242,6 +303,11 @@ def main():
                 "resnet18_vs_baseline": round(
                     resnet_ips / BASELINE_RESNET_IMAGES_PER_SEC, 3
                 ),
+                # configs[3] building block at its DECLARED batch 256 via
+                # 4x64 accumulation (round 4; r3 banked 356 samples/s,
+                # 46.5% MFU at batch 64 monolithic).
+                "bert_large_samples_per_sec_chip": round(bl_sps, 1),
+                "bert_large_mfu_6nd": round(bl_mfu, 4),
             }
         )
     )

@@ -305,17 +305,9 @@ def main():
     if logger:
         logger.close()
     if info["steps"] == 0:
-        # fit() saw zero batches so its final checkpoint never fired;
-        # warmup may still have trained wsteps steps — save them or a
-        # resume loop would retrain them forever.
-        if ckpt_mgr is not None and wsteps:
-            ckpt_mgr.save(int(state.step), state)
-            ckpt_mgr.wait_until_finished()
-        print(
-            f"trained {wsteps} warmup step(s) only — no steady-state "
-            f"throughput window to report" if wsteps else
-            "no training steps this run (budget already met)"
-        )
+        from tpudl.train import finalize_zero_step_run
+
+        print(finalize_zero_step_run(ckpt_mgr, state, wsteps))
         return
     images_per_sec = batch_size * info["steps"] / max(info["seconds"], 1e-9)
     line = (
@@ -330,6 +322,16 @@ def main():
                 batch_size, image_shape=(cfg.image_size, cfg.image_size, 3),
                 num_classes=cfg.num_classes, num_batches=1,
             ))
+            if input_transform is not None:
+                # Parquet-fed runs train on uint8-wire batches; the
+                # lowered example must match or the FLOPs describe a
+                # program that never ran.
+                example = dict(
+                    example,
+                    image=(example["image"] * 255).clip(0, 255).astype(
+                        "uint8"
+                    ),
+                )
             flops = compiled_flops(step.jitted.lower(state, example, rng))
             if flops:
                 step_seconds = info["seconds"] / max(info["steps"], 1)

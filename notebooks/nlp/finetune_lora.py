@@ -84,6 +84,10 @@ def main():
                         "use the Pallas kernels (flash streams any length "
                         "with in-kernel dropout — the seq-2048 configs[4] "
                         "path)")
+    parser.add_argument("--remat", action="store_true",
+                        help="per-layer rematerialization (trade FLOPs "
+                        "for HBM — how billion-param seq-2048 fits one "
+                        "16G chip)")
     parser.add_argument(
         "--hf-checkpoint", type=str, default=None,
         help="local HuggingFace Llama checkpoint directory: base weights "
@@ -102,6 +106,7 @@ def main():
         cfg.model, cfg.num_classes,
         dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
         attention_impl=args.attn,
+        remat=args.remat,
     )
 
     sample = jnp.zeros((1, args.seq_len), jnp.int32)

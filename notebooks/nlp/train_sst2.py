@@ -373,18 +373,9 @@ def main():
         logger.close()
 
     if info["steps"] == 0:
-        # fit() saw zero batches, so ITS final checkpoint never fired —
-        # but the warmup loop may still have trained wsteps optimizer
-        # steps (a resume landing within warmup_steps of the budget).
-        # Without this save those steps would be retrained forever.
-        if ckpt_mgr is not None and wsteps:
-            ckpt_mgr.save(int(state.step), state)
-            ckpt_mgr.wait_until_finished()
-        if wsteps:
-            print(f"trained {wsteps} warmup step(s) only — no "
-                  f"steady-state throughput window to report")
-        else:
-            print("no training steps this run (budget already met)")
+        from tpudl.train import finalize_zero_step_run
+
+        print(finalize_zero_step_run(ckpt_mgr, state, wsteps))
         return
     samples_per_sec = batch_size * info["steps"] / info["seconds"]
     # 6ND transformer estimate by default (the BASELINE.md basis);

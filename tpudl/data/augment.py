@@ -181,7 +181,9 @@ class BatchAugmenter:
             )
         n, h, w, c = images.shape
         ch, cw = self.crop
-        if len(self._mean) != c:
+        if self.normalize and len(self._mean) != c:
+            # (normalize=False never touches mean/std — a pure crop/flip
+            # pipeline over grayscale/RGBA needs no constants.)
             raise ValueError(
                 f"mean/std have {len(self._mean)} channels, images have {c}"
             )

@@ -7,6 +7,7 @@ from tpudl.train.loop import (  # noqa: F401
     create_train_state,
     cross_entropy_loss,
     evaluate,
+    finalize_zero_step_run,
     fit,
     make_classification_eval_step,
     make_classification_train_step,

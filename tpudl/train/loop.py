@@ -482,6 +482,25 @@ def evaluate(
     return {k: float(v) / n_examples for k, v in totals.items()}
 
 
+def finalize_zero_step_run(
+    checkpoint_manager, state: TrainState, warmup_steps_run: int
+) -> str:
+    """Shared driver epilogue for runs where fit() saw zero batches (a
+    resume landed at — or within warmup of — the step budget): fit's
+    final checkpoint never fired, so any warmup-trained steps must be
+    saved here or every rerun would retrain them forever. Returns the
+    status line to print."""
+    if checkpoint_manager is not None and warmup_steps_run:
+        checkpoint_manager.save(int(state.step), state)
+        checkpoint_manager.wait_until_finished()
+    if warmup_steps_run:
+        return (
+            f"trained {warmup_steps_run} warmup step(s) only — no "
+            f"steady-state throughput window to report"
+        )
+    return "no training steps this run (budget already met)"
+
+
 def resume_latest(
     checkpoint_manager,
     state: TrainState,
