@@ -178,12 +178,14 @@ def attend(
                     reference numerics on CPU — ulysses_attention's
                     local_impl parameter pins either).
 
-    Attention-probability dropout is supported by the reference, fused,
-    flash, AND ulysses implementations (the Pallas kernels draw in-kernel
-    from the TPU hardware PRNG; ulysses folds each mesh slot's position
-    into the key and applies per-head dropout on its fully-local
-    sequences). Ring rejects a nonzero rate rather than silently dropping
-    it — its softmax is distributed across sp shards.
+    Attention-probability dropout is supported by EVERY implementation
+    (round 4): the Pallas kernels draw in-kernel from the TPU hardware
+    PRNG; ulysses applies per-head dropout on its post-all-to-all local
+    sequences; ring masks the online-softmax numerator per
+    (q-shard, kv-block) tile while denominators stay undropped — exact
+    post-softmax semantics even though the softmax itself is
+    distributed. Sharded paths fold each mesh slot's position into the
+    key, so mask BITS (not statistics) depend on the mesh layout.
     """
     if dropout_rate > 0.0 and dropout_rng is None:
         raise ValueError(
@@ -246,13 +248,13 @@ def attend(
             dropout_rate=dropout_rate, dropout_rng=dropout_rng,
         )
     if implementation == "ring":
-        if dropout_rate > 0.0:
-            raise ValueError(
-                "attention-probability dropout is not supported by ring "
-                "attention (its softmax is distributed across sp shards); "
-                "set attention_dropout=0.0 or use implementation='ulysses'"
-            )
+        # Exact post-softmax dropout despite the distributed softmax:
+        # the online merge keeps denominators undropped and masks only
+        # the numerator per (q-shard, kv-block) tile.
         from tpudl.ops.ring_attention import ring_attention
 
-        return ring_attention(q, k, v, mask=mask, causal=causal)
+        return ring_attention(
+            q, k, v, mask=mask, causal=causal,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        )
     raise ValueError(f"unknown attention implementation: {implementation!r}")

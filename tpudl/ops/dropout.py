@@ -88,3 +88,39 @@ class Dropout(nn.Module):
         if deterministic or self.rate <= 0.0:
             return x
         return dropout(self.make_rng("dropout"), x, self.rate, self.exact)
+
+
+# ---------------------------------------------------------------------------
+# Sharded-attention dropout key plumbing (shared by the ring and ulysses
+# sequence-parallel paths — ONE fold convention, or the two would
+# silently diverge).
+# ---------------------------------------------------------------------------
+
+
+def shard_fold_axes(mesh, axis_name: str, heads_sharded: bool, batch_axes):
+    """(name, size) pairs of the mesh axes whose slots hold DISTINCT
+    data and therefore need distinct dropout masks: the sharded batch
+    axes, the sequence-parallel axis itself, and tp only when heads are
+    genuinely tp-sharded — folding an axis the output is REPLICATED over
+    would make 'replicated' shards disagree."""
+    from tpudl.runtime.mesh import AXIS_TENSOR
+
+    axes = tuple(
+        (a, mesh.shape[a]) for a in batch_axes if mesh.shape[a] > 1
+    )
+    axes += ((axis_name, mesh.shape[axis_name]),)
+    if heads_sharded:
+        axes += ((AXIS_TENSOR, mesh.shape[AXIS_TENSOR]),)
+    return axes
+
+
+def device_fold_rng(key_data, key_impl, fold_axes):
+    """Inside a shard_map body: re-wrap the replicated raw key data and
+    fold in this device's mixed-radix position over ``fold_axes``."""
+    import jax
+
+    rng = jax.random.wrap_key_data(key_data, impl=key_impl)
+    idx = 0
+    for name, size in fold_axes:
+        idx = idx * size + jax.lax.axis_index(name)
+    return jax.random.fold_in(rng, idx)

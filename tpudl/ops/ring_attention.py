@@ -30,16 +30,38 @@ from tpudl.ops.attention import MASK_VALUE
 from tpudl.runtime.mesh import AXIS_SEQ, BATCH_AXES, AXIS_TENSOR
 
 
-def _ring_local(q, k, v, kvm, *, axis_name, scale, causal):
+def _ring_local(q, k, v, kvm, key_data=None, *, axis_name, scale, causal,
+                dropout_rate=0.0, key_impl=None, fold_axes=()):
     """Per-device ring loop. q, k, v: [b, s_local, h, d]; kvm: [b, s_local].
 
     Device i starts holding kv block i; after t rotations it holds block
     (i - t) mod n. The online-softmax merge is the same recurrence as the
     flash kernel's (tpudl.ops.flash_attention), at shard granularity.
+
+    Dropout (round 4) uses the flash kernel's factorization: dropout acts
+    AFTER softmax normalization, so the denominator ``l`` accumulates
+    undropped probabilities while only the p@V numerator is masked, with
+    the 1/(1-rate) rescale applied once at the end. Masks are a pure
+    function of (key, q-shard position, kv rotation index), drawn with
+    the low-width-bits generator per tile — autodiff through the scan
+    replays the identical draw, so forward and backward masks agree by
+    construction (no custom-vjp contract needed at this granularity).
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_l, h, d = q.shape
+
+    dropout_on = dropout_rate > 0.0
+    if dropout_on:
+        from tpudl.ops.dropout import (
+            device_fold_rng,
+            dropout_keep_mask,
+            quantized_rate,
+        )
+
+        rng = device_fold_rng(key_data, key_impl, fold_axes)
+        eff_rate = quantized_rate(dropout_rate, exact=False)
+        inv_keep = 1.0 / (1.0 - eff_rate)
 
     q32 = q.astype(jnp.float32)
     m0 = jnp.full((b, h, s_l, 1), MASK_VALUE, jnp.float32)
@@ -63,9 +85,20 @@ def _ring_local(q, k, v, kvm, *, axis_name, scale, causal):
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m - m_new)
+        # Denominator: UNDROPPED p (dropout acts post-normalization).
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_on:
+            # Tile keyed by the GLOBAL kv block this device holds at
+            # tick t — every (q-shard, kv-block) pair draws its own mask.
+            keep_d = dropout_keep_mask(
+                jax.random.fold_in(rng, src), p.shape, dropout_rate,
+                exact=False,
+            )
+            p_num = jnp.where(keep_d, p, 0.0)
+        else:
+            p_num = p
         acc = acc * corr + jnp.einsum(
-            "bhqk,bkhd->bhqd", p.astype(v.dtype), v
+            "bhqk,bkhd->bhqd", p_num.astype(v.dtype), v
         ).astype(jnp.float32)
         k, v, kvm = (
             jax.lax.ppermute(x, axis_name, perm) for x in (k, v, kvm)
@@ -76,7 +109,10 @@ def _ring_local(q, k, v, kvm, *, axis_name, scale, causal):
         body, (m0, l0, acc0, k, v, kvm), jnp.arange(n)
     )
     l_safe = jnp.where(l > 0.0, l, 1.0)
-    o = (acc / l_safe).transpose(0, 2, 1, 3)  # [b, s_l, h, d]
+    o = acc / l_safe
+    if dropout_on:
+        o = o * inv_keep
+    o = o.transpose(0, 2, 1, 3)  # [b, s_l, h, d]
     return o.astype(q.dtype)
 
 
@@ -89,6 +125,8 @@ def ring_attention(
     scale: Optional[float] = None,
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_SEQ,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequence-parallel attention on [B, S, H, D] (the
     tpudl.ops.attention contract; Sq == Skv required — queries and keys
@@ -99,9 +137,20 @@ def ring_attention(
     ``mesh`` defaults to the active tpudl mesh
     (tpudl.parallel.sharding.active_mesh); batch shards over (dp, fsdp),
     sequence over `sp`, heads over `tp`.
+
+    ``dropout_rate`` > 0 (round 4): attention-probability dropout with
+    exact post-softmax semantics despite the distributed softmax — the
+    online merge keeps the denominator undropped while the numerator is
+    masked per (q-shard, kv-block) tile (see _ring_local). Rate
+    quantizes to 1/256 (the low-width-bits generator). Each mesh slot
+    folds its position into ``dropout_rng``; mask BITS therefore depend
+    on the mesh layout, like every sharded dropout path.
     """
     from tpudl.ops.attention import normalize_kv_mask, unmeshed_attention
     from tpudl.parallel.sharding import current_mesh
+
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires a dropout_rng")
 
     if mesh is None:
         mesh = current_mesh()
@@ -109,7 +158,10 @@ def ring_attention(
         # No mesh (single-device init/eval): ring degenerates to reference
         # attention — numerically identical, so models with
         # attention_impl="ring" init and evaluate unmeshed.
-        return unmeshed_attention(q, k, v, mask, causal, scale)
+        return unmeshed_attention(
+            q, k, v, mask, causal, scale,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        )
     b, s, h, d = q.shape
     if k.shape[1] != s:
         raise ValueError(
@@ -125,13 +177,32 @@ def ring_attention(
     kvm = normalize_kv_mask(mask, b, s, impl="ring_attention")
 
     batch = tuple(a for a in BATCH_AXES if mesh.shape[a] > 1) or None
-    heads = AXIS_TENSOR if h % max(mesh.shape[AXIS_TENSOR], 1) == 0 else None
+    n_tp = mesh.shape[AXIS_TENSOR]
+    heads_sharded = h % max(n_tp, 1) == 0 and n_tp > 1
+    heads = AXIS_TENSOR if heads_sharded else None
     qkv_spec = P(batch, axis_name, heads, None)
+    key_impl = (
+        jax.random.key_impl(dropout_rng) if dropout_rate > 0.0 else None
+    )
+    from tpudl.ops.dropout import shard_fold_axes
+
+    fold_axes = shard_fold_axes(mesh, axis_name, heads_sharded, BATCH_AXES)
+    body = partial(
+        _ring_local, axis_name=axis_name, scale=scale, causal=causal,
+        dropout_rate=dropout_rate, key_impl=key_impl, fold_axes=fold_axes,
+    )
+    operands = [q, k, v, kvm]
+    in_specs = [qkv_spec, qkv_spec, qkv_spec, P(batch, axis_name)]
+    if dropout_rate > 0.0:
+        operands.append(jax.random.key_data(dropout_rng))
+        in_specs.append(
+            P(*([None] * jax.random.key_data(dropout_rng).ndim))
+        )
     fn = jax.shard_map(
-        partial(_ring_local, axis_name=axis_name, scale=scale, causal=causal),
+        body,
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(batch, axis_name)),
+        in_specs=tuple(in_specs),
         out_specs=qkv_spec,
         check_vma=False,
     )
-    return fn(q, k, v, kvm)
+    return fn(*operands)

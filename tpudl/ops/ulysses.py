@@ -36,22 +36,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpudl.runtime.mesh import AXIS_SEQ, BATCH_AXES, AXIS_TENSOR
 
 
-def _device_dropout_rng(key_data, key_impl, fold_axes):
-    """Per-device dropout key inside the shard_map: fold the device's
-    linear position over ``fold_axes`` ((name, size) pairs — the axes
-    whose slots hold DIFFERENT examples/heads after the all-to-all, as
-    computed by ulysses_attention) into the caller's key; identical local
-    masks would otherwise correlate dropout across those slots. Axes the
-    output is REPLICATED over (e.g. tp when heads aren't tp-sharded) must
-    NOT be folded — divergent values on a replicated-out axis would be
-    assembled inconsistently."""
-    rng = jax.random.wrap_key_data(key_data, impl=key_impl)
-    idx = 0
-    for name, size in fold_axes:
-        idx = idx * size + jax.lax.axis_index(name)
-    return jax.random.fold_in(rng, idx)
-
-
 def _ulysses_local(q, k, v, kvm=None, key_data=None, *, axis_name, causal,
                    scale, local_impl, dropout_rate=0.0, key_impl=None,
                    fold_axes=()):
@@ -64,8 +48,9 @@ def _ulysses_local(q, k, v, kvm=None, key_data=None, *, axis_name, causal,
     Dropout: after the all-to-all each device holds FULL sequences for
     its head slice, so attention-probability dropout is exact BERT/Llama
     semantics applied locally (in-kernel hardware PRNG under flash;
-    jax.random masks under reference) — the property ring attention
-    lacks (its softmax is distributed, so it still rejects dropout)."""
+    jax.random masks under reference). Ring achieves the same semantics
+    differently — numerator-only masking inside its distributed-softmax
+    merge (tpudl.ops.ring_attention)."""
     from tpudl.ops.attention import dot_product_attention
 
     n = jax.lax.psum(1, axis_name)
@@ -87,7 +72,9 @@ def _ulysses_local(q, k, v, kvm=None, key_data=None, *, axis_name, causal,
 
     rng = None
     if dropout_rate > 0.0:
-        rng = _device_dropout_rng(key_data, key_impl, fold_axes)
+        from tpudl.ops.dropout import device_fold_rng
+
+        rng = device_fold_rng(key_data, key_impl, fold_axes)
 
     if local_impl == "flash":
         # Pallas flash kernel on the head slice: peak memory stays linear
@@ -212,16 +199,9 @@ def ulysses_attention(
     key_impl = (
         jax.random.key_impl(dropout_rng) if dropout_rate > 0.0 else None
     )
-    # Axes whose slots see distinct data and so need distinct dropout
-    # masks: the sharded batch axes, the all-to-all axis itself, and tp
-    # ONLY when heads are genuinely tp-sharded (folding an axis the
-    # output is replicated over would assemble inconsistent shards).
-    fold_axes = tuple(
-        (a, mesh.shape[a]) for a in (BATCH_AXES if batch else ())
-        if mesh.shape[a] > 1
-    ) + ((axis_name, n_sp),) + (
-        ((AXIS_TENSOR, n_tp),) if heads_sharded else ()
-    )
+    from tpudl.ops.dropout import shard_fold_axes
+
+    fold_axes = shard_fold_axes(mesh, axis_name, heads_sharded, BATCH_AXES)
     body = partial(_ulysses_local, axis_name=axis_name, causal=causal,
                    scale=scale, local_impl=local_impl,
                    dropout_rate=dropout_rate, key_impl=key_impl,
