@@ -33,11 +33,13 @@ from tpudl.runtime import use_hardware_rng
 use_hardware_rng()
 
 # Values banked in BASELINE.md (1x TPU v5 lite).
-# Re-banked 2026-07-31 under the best-of-4-windows protocol (median of
-# same-day best-of-window measurements 25.1k/29.9k/35.0k/36.9k — the
-# ambient relay throughput drifts ~±20% across hours, so treat this
-# ratio as noisy; the BERT metric's 170 ms steps are stable ±1.5%).
-BASELINE_RESNET_IMAGES_PER_SEC = 30_000.0
+# Protocol hygiene (round 5): the measurement below is best-of-4-windows,
+# so the banked side must be too — the best of the same-day
+# best-of-window runs 25.1k/29.9k/35.0k/36.9k. Like-vs-like (best vs
+# best); the key name carries the protocol. The ambient relay throughput
+# drifts ~±20% across hours, so treat this ratio as noisy regardless;
+# the BERT metric's 170 ms steps are stable ±1.5% and carry the headline.
+BASELINE_RESNET_IMAGES_PER_SEC_BEST = 36_900.0
 BASELINE_RESNET50_IMAGES_PER_SEC = 2482.6  # banked 2026-07-30 (round 2)
 # Re-banked at batch 256 (round 2 close: 1320 samples/sec/chip) so
 # vs_baseline is a like-for-like speedup at the same config — the old
@@ -333,9 +335,11 @@ def main():
                 )
                 if BASELINE_RESNET50_IMAGES_PER_SEC
                 else 1.0,
-                "resnet18_images_per_sec_chip": round(resnet_ips, 1),
-                "resnet18_vs_baseline": round(
-                    resnet_ips / BASELINE_RESNET_IMAGES_PER_SEC, 3
+                "resnet18_images_per_sec_chip_best_of_windows": round(
+                    resnet_ips, 1
+                ),
+                "resnet18_vs_baseline_best_vs_best": round(
+                    resnet_ips / BASELINE_RESNET_IMAGES_PER_SEC_BEST, 3
                 ),
                 # configs[3] building block at its DECLARED batch 256 via
                 # 4x64 accumulation (round 4; r3 banked 356 samples/s,
