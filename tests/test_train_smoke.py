@@ -100,3 +100,99 @@ def test_evaluate_weighted_mean():
         evaluate(eval_step, state, [])
     with pytest.raises(ValueError, match="positive"):
         evaluate(eval_step, state, batches, num_steps=0)
+
+
+def test_pad_batch():
+    from tpudl.train.loop import pad_batch
+
+    batch = {
+        "image": np.ones((3, 4, 4, 1), np.float32),
+        "label": np.arange(3, dtype=np.int64),
+    }
+    padded = pad_batch(batch, 8)
+    assert padded["image"].shape == (8, 4, 4, 1)
+    assert padded["label"].shape == (8,)
+    np.testing.assert_array_equal(
+        padded["_valid"], [1, 1, 1, 0, 0, 0, 0, 0]
+    )
+    np.testing.assert_array_equal(padded["image"][3:], 0.0)
+    # Idempotent re-pad extends the mask with zeros.
+    repadded = pad_batch(padded, 10)
+    np.testing.assert_array_equal(
+        repadded["_valid"], [1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+    )
+    with pytest.raises(ValueError, match="pad batch"):
+        pad_batch(batch, 2)
+    with pytest.raises(ValueError, match="ragged"):
+        pad_batch({"a": np.ones((3,)), "b": np.ones((4,))}, 8)
+
+
+def test_evaluate_ragged_tail_pads_not_recompiles(mesh8):
+    """A ragged tail smaller than the shard count neither crashes on
+    divisibility nor compiles a third executable: evaluate() pads it to
+    the leading batch size with a _valid mask, and the weighted metrics
+    equal the exact per-batch computation on the real rows."""
+    from tpudl.train.loop import evaluate
+
+    state = _make_state()
+    raw_step = make_classification_eval_step()
+    eval_step = compile_step(
+        raw_step, mesh8, state, rules=None, donate_state=False, has_rng=False
+    )
+    rngs = iter(jax.random.split(jax.random.key(7), 3))
+
+    def mk(n):
+        r1, r2 = jax.random.split(next(rngs))
+        return {
+            "image": np.asarray(jax.random.normal(r1, (n, 16, 16, 3))),
+            "label": np.asarray(
+                jax.random.randint(r2, (n,), 0, 4), np.int64
+            ),
+        }
+
+    batches = [mk(16), mk(16), mk(4)]  # tail 4 < 8 devices
+    out = evaluate(eval_step, state, batches)
+    # Exact reference: unjitted per-batch metrics at true sizes.
+    expected_loss = sum(
+        float(raw_step(state, b)["loss"]) * b["label"].shape[0]
+        for b in batches
+    ) / 36.0
+    np.testing.assert_allclose(out["loss"], expected_loss, rtol=1e-4)
+    assert eval_step.jitted._cache_size() <= 2
+
+
+def test_evaluate_never_pads_into_mask_unaware_step():
+    """A custom eval step without the mask-aware marker keeps the exact
+    legacy behavior — the tail runs at its true size (padding zeros into
+    a plain-mean step would silently bias its metrics)."""
+    from tpudl.train.loop import evaluate
+
+    seen_sizes = []
+
+    def custom_step(state, batch):
+        bs = batch["label"].shape[0]
+        seen_sizes.append(bs)
+        assert "_valid" not in batch
+        return {"loss": jnp.mean(batch["label"].astype(jnp.float32))}
+
+    batches = [
+        {"label": np.full((n,), 2.0, np.float32)} for n in (8, 8, 2)
+    ]
+    out = evaluate(custom_step, state=None, batches=batches)
+    assert seen_sizes == [8, 8, 2]
+    np.testing.assert_allclose(out["loss"], 2.0, rtol=1e-6)
+    # Explicit pad_to asserts the caller's step handles _valid.
+    seen_sizes.clear()
+    padded_seen = []
+
+    def mask_aware_step(state, batch):
+        padded_seen.append(batch["label"].shape[0])
+        w = batch.get("_valid")
+        lab = batch["label"].astype(jnp.float32)
+        if w is None:
+            return {"loss": jnp.mean(lab)}
+        return {"loss": jnp.sum(lab * w) / jnp.maximum(jnp.sum(w), 1.0)}
+
+    out = evaluate(mask_aware_step, state=None, batches=batches, pad_to=8)
+    assert padded_seen == [8, 8, 8]
+    np.testing.assert_allclose(out["loss"], 2.0, rtol=1e-6)

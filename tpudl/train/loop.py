@@ -16,6 +16,7 @@ from typing import Any, Callable, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -274,6 +275,14 @@ def make_classification_eval_step(
     label_key: str = "label",
     input_transform: Optional[Callable[[dict], dict]] = None,
 ) -> Callable:
+    """Eval step returning mean loss/accuracy over the batch.
+
+    A ``"_valid"`` batch column ([B] 0/1 row mask — see ``pad_batch``)
+    switches the reductions to masked means over the real rows only, so
+    a zero-padded tail batch reports exactly the metrics of its real
+    rows. Without the column the reductions are plain means (the fast
+    path full batches keep).
+    """
     if isinstance(input_keys, str):
         input_keys = (input_keys,)
 
@@ -286,12 +295,59 @@ def make_classification_eval_step(
         logits = state.apply_fn(
             variables, *(batch[k] for k in input_keys), train=False
         )
+        labels = batch[label_key]
+        per_loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        )
+        correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        valid = batch.get("_valid")
+        if valid is None:
+            return {"loss": per_loss.mean(), "accuracy": correct.mean()}
+        w = valid.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
         return {
-            "loss": cross_entropy_loss(logits, batch[label_key]),
-            "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch[label_key]),
+            "loss": jnp.sum(per_loss * w) / denom,
+            "accuracy": jnp.sum(correct * w) / denom,
         }
 
+    # evaluate() may only auto-pad ragged tails into steps that weight
+    # the pads out; this marker (propagated by compile_step) is how it
+    # knows. Custom mask-unaware steps keep exact per-size execution.
+    step._tpudl_mask_aware = True
     return step
+
+
+def pad_batch(batch: dict, to_size: int) -> dict:
+    """Pad every [B, ...] column of ``batch`` to ``to_size`` rows with
+    zeros and add a ``"_valid"`` float32 [to_size] column marking the
+    real rows (1.0) vs the pads (0.0).
+
+    This is how a ragged tail batch rides the SAME compiled executable
+    as the full batches on a sharded mesh: the padded batch keeps the
+    divisible leading dim, and mask-aware consumers
+    (make_classification_eval_step, evaluate) weight the pads out of
+    every metric. An existing ``"_valid"`` column is extended with
+    zeros (already-padded batches pass through idempotently).
+    """
+    sizes = {k: v.shape[0] for k, v in batch.items()}
+    b = next(iter(sizes.values()))
+    if any(s != b for s in sizes.values()):
+        raise ValueError(f"ragged leading dims within one batch: {sizes}")
+    if to_size < b:
+        raise ValueError(f"cannot pad batch of {b} down to {to_size}")
+
+    def _pad0(x, width):
+        widths = [(0, width)] + [(0, 0)] * (x.ndim - 1)
+        if isinstance(x, jax.Array):
+            return jnp.pad(x, widths)
+        return np.pad(np.asarray(x), widths)
+
+    valid = batch.get("_valid")
+    if valid is None:
+        valid = np.ones((b,), np.float32)
+    out = {k: _pad0(v, to_size - b) for k, v in batch.items() if k != "_valid"}
+    out["_valid"] = _pad0(valid, to_size - b)
+    return out
 
 
 def compile_step(
@@ -362,6 +418,7 @@ def compile_step(
     wrapped.jitted = jitted  # expose for lower()/cost analysis
     wrapped.state_shardings = state_sh
     wrapped.batch_sharding = batch_sh
+    wrapped._tpudl_mask_aware = getattr(step_fn, "_tpudl_mask_aware", False)
     return wrapped
 
 
@@ -455,28 +512,55 @@ def evaluate(
     state: TrainState,
     batches: Iterable[dict],
     num_steps: Optional[int] = None,
+    pad_to: Optional[int] = None,
 ) -> dict:
     """Drive a compiled eval step (``compile_step(..., has_rng=False)``)
     over a dataset and return example-weighted mean metrics.
 
-    Metrics are weighted by each batch's leading dim, so a non-dropped
-    smaller last batch is averaged correctly — note that on a sharded
-    mesh its size must still divide the (dp, fsdp) batch axes, and every
-    distinct batch size compiles its own executable (pad or drop_last
-    when that matters). One host sync at the end.
+    Metrics are weighted by each batch's REAL row count, so a smaller
+    last batch is averaged correctly. Ragged tails are handled by
+    padding, not recompilation: the first batch fixes the executable's
+    batch size (or pass ``pad_to`` explicitly), and any later smaller
+    batch is zero-padded to it with a ``"_valid"`` row mask
+    (``pad_batch``) that the eval step weights out — so a ragged-tail
+    dataset costs at most 2 executables (the maskless fast path + one
+    masked variant) and keeps shard divisibility on sharded meshes.
+
+    Padding is only safe for mask-AWARE steps (ones that weight
+    ``"_valid"`` out of their reductions — make_classification_eval_step
+    is; compile_step propagates the marker). A custom step without the
+    marker keeps the exact legacy behavior — every batch runs at its
+    true size (one executable per distinct size, shard divisibility is
+    the caller's problem) — unless ``pad_to`` is passed explicitly,
+    which asserts the step handles ``"_valid"``. Batches LARGER than
+    the target still compile their own executable; pass ``pad_to`` >=
+    the max batch size to avoid that. One host sync at the end.
     """
     if num_steps is not None and num_steps <= 0:
         raise ValueError(f"num_steps must be positive, got {num_steps}")
+    may_pad = pad_to is not None or getattr(
+        compiled_eval_step, "_tpudl_mask_aware", False
+    )
     totals: dict = {}
     n_examples = 0
+    target = pad_to
     for i, batch in enumerate(batches):
         if num_steps is not None and i >= num_steps:
             break
-        metrics = compiled_eval_step(state, batch)
         bs = next(iter(batch.values())).shape[0]
-        n_examples += bs
+        if "_valid" in batch:
+            # Caller pre-padded: the mask knows the real count.
+            weight = float(np.sum(np.asarray(batch["_valid"])))
+        else:
+            weight = bs
+        if target is None:
+            target = bs
+        if bs < target and may_pad:
+            batch = pad_batch(batch, target)
+        metrics = compiled_eval_step(state, batch)
+        n_examples += weight
         for k, v in metrics.items():
-            totals[k] = totals.get(k, 0.0) + v * bs
+            totals[k] = totals.get(k, 0.0) + v * weight
     if n_examples == 0:
         raise ValueError("evaluate() received no batches")
     return {k: float(v) / n_examples for k, v in totals.items()}
