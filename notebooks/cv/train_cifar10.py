@@ -79,10 +79,12 @@ def main():
     parser.add_argument("--materialize", action="store_true",
                         help="generate a synthetic dataset into --data-dir first")
     parser.add_argument("--ingest", type=str, default=None,
-                        help="REAL CIFAR-10 python archive "
-                        "(cifar-10-python.tar.gz or its extracted "
-                        "directory): ingested into --data-dir Parquet "
-                        "before training (tpudl.data.ingest)")
+                        help="REAL dataset to ingest into --data-dir "
+                        "Parquet before training (tpudl.data.ingest): the "
+                        "CIFAR-10 python archive (cifar-10-python.tar.gz "
+                        "or its extracted directory) for cifar10 configs, "
+                        "or a class-subdirectory JPEG/PNG tree (ImageNet "
+                        "train/ layout) for imagenet-shape configs")
     parser.add_argument("--rows", type=int, default=None,
                         help="rows to materialize (default: dataset-specific)")
     parser.add_argument("--strategy", type=str, default=None,
@@ -163,11 +165,14 @@ def main():
         )
 
         if args.ingest:
-            from tpudl.data.ingest import ingest_cifar10
+            from tpudl.data.ingest import ingest_cifar10, ingest_image_folder
 
-            if not is_cifar:
-                parser.error("--ingest supports the CIFAR-10 archive format")
-            conv = ingest_cifar10(args.ingest, args.data_dir)
+            if is_cifar:
+                conv = ingest_cifar10(args.ingest, args.data_dir)
+            else:
+                conv = ingest_image_folder(
+                    args.ingest, args.data_dir, image_size=cfg.image_size,
+                )
             print(f"ingested {args.ingest} -> {args.data_dir} "
                   f"({conv.num_rows} rows)")
         elif args.materialize:

@@ -170,3 +170,81 @@ def test_ingest_sst2_tsv_errors(tmp_path):
         f.write("only-sentence-no-tab\n")
     with pytest.raises(ValueError, match="short row"):
         ingest_sst2_tsv(str(short), str(tmp_path / "o2"))
+
+
+def _write_image_tree(tmp_path, sizes_by_class, fmt="JPEG"):
+    """Class-subdirectory tree of REAL encoded images: each class gets
+    solid-color images (color = class signature) at assorted sizes, so
+    decode/resize/crop geometry and label assignment are both checked."""
+    from PIL import Image
+
+    root = tmp_path / "imagefolder"
+    colors = {"ants": (200, 30, 40), "bees": (20, 180, 60), "cats": (10, 40, 220)}
+    for cls, sizes in sizes_by_class.items():
+        d = root / cls
+        d.mkdir(parents=True)
+        for j, (w, h) in enumerate(sizes):
+            arr = np.zeros((h, w, 3), np.uint8)
+            arr[:] = colors[cls]
+            ext = "jpg" if fmt == "JPEG" else "png"
+            Image.fromarray(arr).save(d / f"img_{j:03d}.{ext}", format=fmt)
+    (root / "notes.txt").write_text("ignored non-image file")
+    return root, colors
+
+
+@pytest.mark.parametrize("fmt", ["JPEG", "PNG"])
+def test_ingest_image_folder_roundtrip(tmp_path, fmt):
+    from tpudl.data.ingest import ingest_image_folder
+
+    sizes = {
+        "ants": [(64, 48), (100, 60)],   # landscape, shorter side = h
+        "bees": [(48, 64), (32, 32)],    # portrait and exact-size
+        "cats": [(33, 47)],              # odd dims
+    }
+    root, colors = _write_image_tree(tmp_path, sizes, fmt)
+    conv = ingest_image_folder(str(root), str(tmp_path / "out"), image_size=32)
+    assert conv.num_rows == 5
+    with open(tmp_path / "out" / "classes.txt") as f:
+        assert f.read().split() == ["ants", "bees", "cats"]
+
+    b = next(conv.make_batch_iterator(5, shuffle=False, drop_last=False,
+                                      shard_index=0, num_shards=1))
+    assert b["image"].shape == (5, 32, 32, 3)
+    assert b["image"].dtype == np.uint8
+    # Sorted-class label order: ants=0 (2 imgs), bees=1 (2), cats=2 (1).
+    np.testing.assert_array_equal(b["label"], [0, 0, 1, 1, 2])
+    by_label = {0: "ants", 1: "bees", 2: "cats"}
+    for img, lab in zip(b["image"], b["label"]):
+        want = np.asarray(colors[by_label[int(lab)]], np.float32)
+        # Solid color survives resize+crop; JPEG is lossy, PNG exact.
+        tol = 4.0 if fmt == "JPEG" else 1.0
+        assert np.abs(img.astype(np.float32) - want).max() <= tol, (lab, img[0, 0])
+
+
+def test_ingest_image_folder_resize_headroom(tmp_path):
+    """resize_shorter > image_size reproduces the standard eval preproc
+    (resize-256 + center-crop-224 shape contract, scaled down)."""
+    from tpudl.data.ingest import ingest_image_folder
+
+    root, _ = _write_image_tree(tmp_path, {"ants": [(80, 50)]}, "PNG")
+    conv = ingest_image_folder(
+        str(root), str(tmp_path / "out"), image_size=28, resize_shorter=32
+    )
+    b = next(conv.make_batch_iterator(1, shuffle=False, drop_last=False,
+                                      shard_index=0, num_shards=1))
+    assert b["image"].shape == (1, 28, 28, 3)
+    with pytest.raises(ValueError, match="upscaling"):
+        ingest_image_folder(str(root), str(tmp_path / "o2"),
+                            image_size=32, resize_shorter=16)
+
+
+def test_ingest_image_folder_errors(tmp_path):
+    from tpudl.data.ingest import ingest_image_folder
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no class subdirectories"):
+        ingest_image_folder(str(empty), str(tmp_path / "o"))
+    (empty / "cls").mkdir()
+    with pytest.raises(ValueError, match="no .*files"):
+        ingest_image_folder(str(empty), str(tmp_path / "o"))
