@@ -81,6 +81,47 @@ def test_exported_roundtrip_reproduces_generate(tmp_path):
         )
 
 
+def test_exported_ragged_padded_batch(tmp_path):
+    """The exported artifacts serve LEFT-padded ragged batches: the
+    cache's per-slot validity travels as explicit I/O, so each padded
+    row reproduces its unpadded generation token for token — the moment
+    'a second input arrives' the serving path still answers correctly."""
+    model, params, ids = _setup()
+    pre, dec = export_decoder(model, params, B, S)
+    prefill_call, decode_call = load_decoder(pre, dec)
+    # Row 0: full-length prompt; row 1: 5 real tokens, left-padded by 3.
+    short = ids[1:2, 3:]
+    mask = jnp.concatenate(
+        [
+            jnp.ones((1, S), jnp.int32),
+            jnp.concatenate(
+                [jnp.zeros((1, 3), jnp.int32), jnp.ones((1, S - 3), jnp.int32)],
+                axis=1,
+            ),
+        ],
+        axis=0,
+    )
+    ragged_ids = jnp.concatenate(
+        [ids[0:1], jnp.concatenate([jnp.zeros((1, 3), jnp.int32), short], 1)],
+        axis=0,
+    )
+    got = generate_with_exported(
+        prefill_call, decode_call, params, ragged_ids,
+        attention_mask=mask, max_new_tokens=NEW, max_seq_len=CFG.max_seq_len,
+    )
+    want0 = generate(model, params, ids[0:1], max_new_tokens=NEW)
+    want1 = generate(model, params, short, max_new_tokens=NEW)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want0[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want1[0]))
+    import pytest
+
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        generate_with_exported(
+            prefill_call, decode_call, params, ragged_ids,
+            attention_mask=mask[:, ::-1], max_new_tokens=2,
+        )
+
+
 def test_exported_eos_padding():
     model, params, ids = _setup()
     pre, dec = export_decoder(model, params, B, S)

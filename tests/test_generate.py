@@ -97,11 +97,106 @@ def test_generate_validates(model_and_params):
     prompt = jnp.zeros((B, S), jnp.int32)
     with pytest.raises(ValueError, match="max_seq_len"):
         generate(model, params, prompt, max_new_tokens=CFG.max_seq_len)
-    with pytest.raises(NotImplementedError, match="unpadded"):
+    right_padded = jnp.concatenate(
+        [jnp.ones((B, S - 2), jnp.int32), jnp.zeros((B, 2), jnp.int32)],
+        axis=1,
+    )
+    with pytest.raises(ValueError, match="LEFT-padded"):
         generate(
-            model,
-            params,
-            prompt,
-            attention_mask=prompt,  # zeros = padded
+            model, params, prompt, attention_mask=right_padded,
             max_new_tokens=2,
         )
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        generate(
+            model, params, prompt,
+            attention_mask=jnp.zeros((B, S), jnp.int32),  # no real tokens
+            max_new_tokens=2,
+        )
+
+
+def _left_pad(prompt, total_len, pad_id=0):
+    """[B, L] -> ([B, total_len] left-padded ids, mask)."""
+    b, length = prompt.shape
+    pad = total_len - length
+    ids = jnp.concatenate(
+        [jnp.full((b, pad), pad_id, prompt.dtype), prompt], axis=1
+    )
+    mask = jnp.concatenate(
+        [jnp.zeros((b, pad), jnp.int32), jnp.ones((b, length), jnp.int32)],
+        axis=1,
+    )
+    return ids, mask
+
+
+def test_left_padded_matches_unpadded(model_and_params):
+    """Uniform left padding is numerically invisible: same tokens as the
+    unpadded batch (pad slots are masked EXACTLY — zero weight — and
+    RoPE positions are mask-aware, so every real dot product is
+    bit-identical)."""
+    model, params = model_and_params
+    prompt = jax.random.randint(jax.random.key(7), (B, S), 1, CFG.vocab_size)
+    want = generate(model, params, prompt, max_new_tokens=NEW)
+    ids, mask = _left_pad(prompt, S + 3)
+    got = generate(model, params, ids, attention_mask=mask,
+                   max_new_tokens=NEW)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ragged_batch_matches_per_prompt(model_and_params):
+    """A ragged left-padded batch generates per row exactly what each
+    prompt generates alone — the serving path's batch-of-real-requests
+    contract (the analog of the reference's inference pipeline taking
+    arbitrary inputs; reference notebooks/cv/onnx_experiments.py:77-140)."""
+    model, params = model_and_params
+    lengths = [3, S, 5]
+    rows = [
+        jax.random.randint(jax.random.key(10 + i), (1, n), 1, CFG.vocab_size)
+        for i, n in enumerate(lengths)
+    ]
+    padded = [_left_pad(r, S) for r in rows]
+    ids = jnp.concatenate([p[0] for p in padded], axis=0)
+    mask = jnp.concatenate([p[1] for p in padded], axis=0)
+    got = generate(model, params, ids, attention_mask=mask,
+                   max_new_tokens=NEW)
+    for i, row in enumerate(rows):
+        want = generate(model, params, row, max_new_tokens=NEW)
+        np.testing.assert_array_equal(
+            np.asarray(got[i]), np.asarray(want[0]), err_msg=f"row {i}"
+        )
+
+
+def test_top_k_and_top_p_truncation(model_and_params):
+    model, params = model_and_params
+    prompt = jax.random.randint(jax.random.key(20), (B, S), 1, CFG.vocab_size)
+    greedy = generate(model, params, prompt, max_new_tokens=NEW)
+    # top_k=1 and a top_p below any single-token mass both reduce to
+    # greedy regardless of temperature.
+    got_k = generate(model, params, prompt, max_new_tokens=NEW,
+                     temperature=1.0, top_k=1, rng=jax.random.key(21))
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(greedy))
+    got_p = generate(model, params, prompt, max_new_tokens=NEW,
+                     temperature=1.0, top_p=1e-9, rng=jax.random.key(22))
+    np.testing.assert_array_equal(np.asarray(got_p), np.asarray(greedy))
+    # top_k=5: every sampled FIRST token lies in the prompt's top-5.
+    logits, _ = model.apply(
+        {"params": params}, prompt, jnp.ones_like(prompt), decode=True,
+        positions=jnp.broadcast_to(jnp.arange(S)[None, :], (B, S)),
+        mutable=["cache"],
+    )
+    top5 = np.asarray(jax.lax.top_k(logits[:, -1, :], 5)[1])
+    for trial in range(5):
+        got = generate(model, params, prompt, max_new_tokens=1,
+                       temperature=2.0, top_k=5, rng=jax.random.key(30 + trial))
+        for b in range(B):
+            assert int(got[b, 0]) in top5[b], (trial, b)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, max_new_tokens=1,
+                 temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, max_new_tokens=1,
+                 temperature=1.0, top_p=0.0)
+    # Pairing truncation with greedy is an error, not a silent no-op.
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(model, params, prompt, max_new_tokens=1, top_k=50)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(model, params, prompt, max_new_tokens=1, top_p=0.9)

@@ -30,8 +30,13 @@ from tpudl.export.export import export_stablehlo, load_exported
 
 # The functional prefill/decode contracts live with the live generation
 # loop (one definition — the exported artifacts CANNOT diverge from
-# generate()); re-exported here for the serving-side API.
-from tpudl.models.generate import decode_fn, prefill_fn  # noqa: F401
+# generate()); re-exported here for the serving-side API. The padded-mask
+# contract is shared the same way.
+from tpudl.models.generate import (  # noqa: F401
+    decode_fn,
+    prefill_fn,
+    validate_left_padded,
+)
 
 
 def export_decoder(
@@ -78,14 +83,18 @@ def generate_with_exported(
     decode_call: Callable,
     params,
     input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
     max_new_tokens: int = 32,
     eos_id: Optional[int] = None,
     max_seq_len: Optional[int] = None,
 ) -> jax.Array:
     """Greedy generation driven entirely by deserialized artifacts — the
-    session.run loop of the reference, over StableHLO. Prompts must be
-    unpadded (the tpudl.models.generate cache contract). Returns
-    [B, max_new_tokens] token ids, eos-padded like generate().
+    session.run loop of the reference, over StableHLO. Ragged prompt
+    batches ride LEFT-padded through ``attention_mask`` (0 = pad; same
+    contract as tpudl.models.generate — the exported cache carries the
+    per-slot validity mask, so padded rows reproduce their unpadded
+    tokens). Returns [B, max_new_tokens] token ids, eos-padded like
+    generate().
 
     ``max_seq_len`` is the exporting model's KV-cache bound
     (model.cfg.max_seq_len) — the deserialized callables cannot see it,
@@ -98,9 +107,13 @@ def generate_with_exported(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
             f"exporting model's KV-cache bound max_seq_len={max_seq_len}"
         )
-    mask = jnp.ones_like(input_ids)
+    if attention_mask is None:
+        mask = jnp.ones_like(input_ids)
+    else:
+        mask = attention_mask
+        validate_left_padded(mask)
     logits, cache = prefill_call(params, input_ids, mask)
-    position = jnp.full((b,), s, jnp.int32)
+    position = jnp.sum(mask, axis=-1).astype(jnp.int32)
     token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     done = jnp.zeros((b,), bool)
     tokens = []

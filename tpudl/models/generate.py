@@ -7,9 +7,12 @@ prefill + a jitted single-token decode step over static-shape KV caches
 (tpudl.models.llama.LlamaAttention decode mode), so the whole generation
 loop runs as two compiled XLA programs regardless of length.
 
-Greedy (temperature=0) or temperature sampling. Prompts must be unpadded
-(cache slot == absolute position keeps the in-cache causal mask a pure
-index comparison); batch prompts of equal length or generate per group.
+Greedy (temperature=0), temperature, top-k, and top-p (nucleus)
+sampling. Ragged prompt batches are served LEFT-padded: the cache marks
+padded slots invalid (LlamaAttention's ``valid`` buffer) and masks by
+slot write-order, while mask-aware positions keep RoPE phases identical
+to the unpadded prompt — so a left-padded row generates token-for-token
+what it would alone (tests/test_generate.py).
 """
 
 from __future__ import annotations
@@ -72,12 +75,68 @@ def _decode_step(model, params, cache, token, position):
     return decode_fn(model)(params, cache, token, position)
 
 
-def _select(logits, rng, temperature):
+_NEG_INF = -1e30
+
+
+def validate_sampling(temperature, top_k, top_p) -> None:
+    """Reject sampling-parameter combinations that would silently not do
+    what was asked: top_k/top_p only apply to the categorical branch, so
+    pairing them with greedy (temperature 0) is an error, not a no-op."""
+    if temperature == 0.0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (temperature=0.0 is "
+            "greedy argmax and would silently ignore them)"
+        )
+    if top_k is not None and not 0 < top_k:
+        raise ValueError(f"top_k must be positive, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def validate_left_padded(attention_mask) -> None:
+    """Shared left-padded-mask contract for the live loop AND the
+    exported serving loop (tpudl.export.decode — one definition, the
+    paths cannot diverge): every row must be 0s then 1s with at least
+    one real token. Right padding would leave the final slot — whose
+    logits seed generation — on a pad. One host sync."""
+    ok = jnp.logical_and(
+        jnp.all(attention_mask[:, 1:] >= attention_mask[:, :-1]),
+        jnp.all(jnp.sum(attention_mask, axis=-1) > 0),
+    )
+    if not bool(ok):
+        raise ValueError(
+            "ragged prompt batches are served LEFT-padded: every "
+            "attention_mask row must be 0s then 1s with at least one "
+            "real token (right-padding would leave the final slot — "
+            "whose logits seed generation — on a pad)"
+        )
+
+
+def _select(logits, rng, temperature, top_k=None, top_p=None):
+    """Next-token selection on [B, V] logits: greedy at temperature 0,
+    else categorical over temperature-scaled logits optionally truncated
+    to the top-k tokens and/or the top-p (nucleus) probability mass.
+    top_p keeps the smallest prefix of probability-sorted tokens whose
+    cumulative mass reaches p (the argmax always survives). Parameter
+    combinations are checked once by validate_sampling, not per step.
+    """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
-        jnp.int32
-    )
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p is not None:
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Exclusive cumulative mass: a token is kept while the mass
+        # BEFORE it is < p, so the prefix that first reaches p survives.
+        keep_sorted = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, _NEG_INF)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(
@@ -87,6 +146,8 @@ def generate(
     attention_mask: Optional[jax.Array] = None,
     max_new_tokens: int = 32,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     eos_id: Optional[int] = None,
     rng: Optional[jax.Array] = None,
     eos_check_every: int = 8,
@@ -94,18 +155,20 @@ def generate(
     """Generate continuations for a [B, S] prompt batch.
 
     ``model`` is a LlamaForCausalLM whose config ``max_seq_len`` bounds
-    S + max_new_tokens. Returns [B, max_new_tokens] generated ids (after
-    ``eos_id``, positions are padded with eos). ``eos_check_every`` paces
-    the all-rows-done early-exit readback (1 = check every token).
+    S + max_new_tokens. Ragged prompts batch via LEFT-padding: pad short
+    rows on the left and pass ``attention_mask`` (0 = pad); each row then
+    generates exactly what it would unpadded. ``temperature``/``top_k``/
+    ``top_p`` select the sampling rule (see ``_select``). Returns
+    [B, max_new_tokens] generated ids (after ``eos_id``, positions are
+    padded with eos). ``eos_check_every`` paces the all-rows-done
+    early-exit readback (1 = check every token).
     """
     b, s = input_ids.shape
+    validate_sampling(temperature, top_k, top_p)
     if attention_mask is None:
         attention_mask = jnp.ones_like(input_ids)
-    elif not bool(jnp.all(attention_mask == 1)):
-        raise NotImplementedError(
-            "generate() requires unpadded prompts (attention_mask all "
-            "ones): the KV cache indexes by slot == position"
-        )
+    else:
+        validate_left_padded(attention_mask)
     if eos_check_every < 1:
         raise ValueError(
             f"eos_check_every must be >= 1 (1 = check every token), got "
@@ -126,7 +189,7 @@ def generate(
     tokens = []
     done = jnp.zeros((b,), bool)
     rng, sel_rng = jax.random.split(rng)
-    token = _select(logits, sel_rng, temperature)
+    token = _select(logits, sel_rng, temperature, top_k, top_p)
     for i in range(max_new_tokens):
         if eos_id is not None:
             token = jnp.where(done, eos_id, token)
@@ -150,5 +213,5 @@ def generate(
         rng, step_rng = jax.random.split(rng)
         logits, cache = _decode_step(model, params, cache, token, position)
         position = position + 1
-        token = _select(logits, step_rng, temperature)
+        token = _select(logits, step_rng, temperature, top_k, top_p)
     return jnp.stack(tokens, axis=1)

@@ -190,6 +190,15 @@ class LlamaAttention(nn.Module):
                 "cache", "v",
                 jnp.zeros, (B, cfg.max_seq_len, cfg.num_kv_heads, hd), v.dtype,
             )
+            # Per-slot validity: padded prompt slots hold garbage k/v and
+            # must never be attended. Written alongside k/v from the
+            # chunk's kv_mask, so the cache knows which of its slots are
+            # real — the contract that lets generate() serve ragged
+            # (left-padded) prompt batches.
+            cvalid = self.variable(
+                "cache", "valid",
+                jnp.zeros, (B, cfg.max_seq_len), jnp.bool_,
+            )
             idx = self.variable(
                 "cache", "index", lambda: jnp.zeros((), jnp.int32)
             )
@@ -200,13 +209,23 @@ class LlamaAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v, (0, start, 0, 0)
             )
+            chunk_valid = (
+                jnp.ones((B, S), jnp.bool_)
+                if kv_mask is None
+                else kv_mask.astype(jnp.bool_)
+            )
+            cvalid.value = jax.lax.dynamic_update_slice(
+                cvalid.value, chunk_valid, (0, start)
+            )
             idx.value = start + S
             k, v = ck.value, cv.value
-            # Attend only to written positions; within the current chunk,
-            # causal ordering holds (kv_pos <= query position).
-            kv_pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
-            q_pos = positions[:, None, :, None]  # [B, 1, S, 1]
-            mask = kv_pos <= q_pos
+            # Attend to slots that are (a) causally prior in WRITE order —
+            # slots fill in token order, so slot order IS causal order
+            # regardless of padding — and (b) valid. Positions (which pads
+            # alias) play no role in masking; they only drive RoPE phases.
+            kv_slot = jnp.arange(cfg.max_seq_len)[None, None, None, :]
+            q_slot = (start + jnp.arange(S))[None, None, :, None]
+            mask = (kv_slot <= q_slot) & cvalid.value[:, None, None, :]
         else:
             mask = None
 
