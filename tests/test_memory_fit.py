@@ -1,0 +1,34 @@
+"""configs[4] at its declared 8B scale, validated abstractly
+(scripts/memory_fit.py): eval_shape + real NamedShardings, zero bytes
+allocated. The deployment claim in BASELINE.json configs[4]
+("FSDP->GSPMD sharding on v5p-64") becomes a computed, asserted fact."""
+
+import importlib.util
+import pathlib
+
+spec = importlib.util.spec_from_file_location(
+    "memory_fit",
+    pathlib.Path(__file__).resolve().parents[1] / "scripts" / "memory_fit.py",
+)
+memory_fit = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(memory_fit)
+
+
+def test_llama3_8b_lora_fits_conftest_mesh():
+    """On the 8-fake-device conftest mesh (fit: fsdp=8) the full 8B LoRA
+    state must fit the v5p bar; moments must be LoRA-small."""
+    out = memory_fit.report("llama3_8b_lora", 8, 95.0)
+    assert out["fits"], out
+    bb = out["bytes_per_device"]
+    assert out["params_total"] > 7e9  # genuinely the 8B shape
+    assert out["params_trainable"] < 1e8  # LoRA + head only
+    # Frozen base carries no moments: moments are orders of magnitude
+    # below the master params.
+    assert bb["opt_moments"] < bb["params"] / 10
+    # Every component accounted and positive.
+    for k in ("params", "opt_moments", "activations_upper_bound",
+              "largest_allgathered_kernel"):
+        assert bb[k] > 0, k
+    assert bb["total"] == sum(
+        bb[k] for k in bb if k != "total"
+    )
