@@ -142,3 +142,100 @@ def converter_fed_train(data_dir, local_batch=16):
         logger=log,
     )
     return losses, rows["n"]
+
+
+def _ckpt_state():
+    """Deterministic tiny state with BatchNorm stats AND momentum — both
+    must round-trip through the multi-process checkpoint."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.models.resnet import ResNetTiny
+    from tpudl.train import create_train_state
+
+    model = ResNetTiny(num_classes=4)
+    return create_train_state(
+        jax.random.key(0), model, jnp.zeros((1, 16, 16, 3)),
+        optax.sgd(0.05, momentum=0.9),
+    )
+
+
+def _ckpt_batches(n):
+    """Seeded global batch stream — every process regenerates the same
+    sequence, so 'fast-forward past the consumed steps' is list slicing."""
+    from tpudl.data.synthetic import synthetic_classification_batches
+
+    return list(
+        synthetic_classification_batches(
+            16, image_shape=(16, 16, 3), num_classes=4, num_batches=n, seed=7
+        )
+    )
+
+
+def _ckpt_train(state, step, mesh, batches, rng):
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    losses = []
+    for b in batches:
+        gb = {
+            k: multihost_utils.host_local_array_to_global_array(
+                v, mesh, P(("dp", "fsdp"))
+            )
+            for k, v in b.items()
+        }
+        state, m = step(state, gb, rng)
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+def checkpoint_save_phase(ckpt_dir, steps=3):
+    """Phase 1 of the multi-process recovery story: train, save via
+    CheckpointManager from EVERY rank (Orbax coordinates the write across
+    processes), drain, exit — the 'kill' is the process exit itself."""
+    import jax
+
+    from tpudl.checkpoint import CheckpointManager
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+    from tpudl.train import compile_step, make_classification_train_step
+
+    state = _ckpt_state()
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(make_classification_train_step(), mesh, state, None)
+    state, losses = _ckpt_train(
+        state, step, mesh, _ckpt_batches(steps), jax.random.key(1)
+    )
+    with CheckpointManager(ckpt_dir) as mgr:
+        mgr.save(steps, state)
+        mgr.wait_until_finished()
+    return jax.process_index(), losses
+
+
+def checkpoint_resume_phase(ckpt_dir, total_steps=5, saved_steps=3):
+    """Phase 2 (a FRESH spawn): restore on every rank (sharding-aware,
+    mesh-placed), train the remaining batches, and also run an
+    uninterrupted from-scratch control — the post-resume losses must
+    equal the control's tail exactly (the train step folds the rng with
+    state.step, which the checkpoint carries)."""
+    import jax
+
+    from tpudl.checkpoint import CheckpointManager
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+    from tpudl.train import compile_step, make_classification_train_step
+
+    template = _ckpt_state()
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(make_classification_train_step(), mesh, template, None)
+    with CheckpointManager(ckpt_dir) as mgr:
+        restored_step = mgr.latest_step()
+        state = mgr.restore(template, mesh=mesh, rules=None)
+    batches = _ckpt_batches(total_steps)
+    state, resumed = _ckpt_train(
+        state, step, mesh, batches[saved_steps:], jax.random.key(1)
+    )
+    control_state = _ckpt_state()
+    control_state, control = _ckpt_train(
+        control_state, step, mesh, batches, jax.random.key(1)
+    )
+    return jax.process_index(), int(restored_step), resumed, control

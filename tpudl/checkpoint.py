@@ -91,6 +91,22 @@ class CheckpointManager:
 
     save() is asynchronous (training continues while shards flush);
     close()/context-manager exit drains pending writes.
+
+    Multi-process invariants (proved by
+    tests/test_distributor.py::test_spawn_checkpoint_save_resume — a
+    2-process TpuDistributor spawn that trains, saves, exits, and a
+    FRESH spawn restores and continues):
+
+    - every rank calls save()/restore() collectively; Orbax coordinates
+      the write over jax.distributed (which TpuDistributor initializes)
+      and the shared checkpoint directory, so no rank-0-only gating is
+      needed in caller code;
+    - restore() with mesh/rules materializes each rank's addressable
+      shards directly onto its devices (no full-state host replication);
+    - the restored trajectory is EXACTLY the uninterrupted one: params,
+      optimizer momenta, BatchNorm stats, and the step counter (which
+      seeds the per-step dropout/rng fold) all round-trip, and all
+      ranks report identical global losses after the resume boundary.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):

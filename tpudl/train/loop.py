@@ -401,15 +401,56 @@ def compile_step(
         #   training step.
         # Committed args pass through untouched, so the steady state is a
         # no-op scan over the leaves.
-        leaves = jax.tree.leaves(tree)
+        leaves, treedef = jax.tree.flatten(tree)
         if all(
             isinstance(leaf, jax.Array) and leaf.committed
             for leaf in leaves
         ):
             return tree
-        return jax.device_put(tree, shardings)
+        # Leaf-wise placement, NOT jax.device_put(tree, shardings): the
+        # whole-tree form compares treedefs including static pytree
+        # fields, so a TrainState rebuilt by the same code (fresh
+        # apply_fn/tx closures, identical array structure) would be
+        # rejected as a structure mismatch. A single Sharding (the batch
+        # prefix case) broadcasts over all leaves.
+        if isinstance(shardings, jax.sharding.Sharding):
+            sh_leaves = [shardings] * len(leaves)
+        else:
+            sh_leaves = jax.tree.leaves(shardings)
+        placed = jax.device_put(leaves, sh_leaves)
+        return jax.tree.unflatten(treedef, placed)
+
+    state_treedef = jax.tree.structure(state)
+    warned_graft = []
 
     def wrapped(state_arg, batch, *rest):
+        if jax.tree.structure(state_arg) != state_treedef:
+            # Same array structure, different static metadata: a
+            # TrainState rebuilt by the same code carries fresh
+            # apply_fn/tx closures that compare unequal, which pjit's
+            # in_shardings prefix matching rejects. The executable
+            # encodes the ORIGINAL tx, so grafting the incoming leaves
+            # into the compile-time treedef is the correct semantics
+            # (leaf-count mismatches still raise here). Warn once: if
+            # the caller's rebuilt state genuinely carries DIFFERENT
+            # hyperparameters (a new lr, a different schedule), they
+            # are silently superseded by the compiled ones.
+            if not warned_graft:
+                warned_graft.append(True)
+                import warnings
+
+                warnings.warn(
+                    "compile_step: incoming state's pytree metadata "
+                    "(apply_fn/tx) differs from the compile-time state; "
+                    "its array leaves are grafted into the ORIGINAL "
+                    "treedef and the ORIGINAL compiled optimizer applies "
+                    "— rebuild the compiled step if you changed "
+                    "optimizer hyperparameters",
+                    stacklevel=2,
+                )
+            state_arg = jax.tree.unflatten(
+                state_treedef, jax.tree.leaves(state_arg)
+            )
         state_arg = _placed(state_arg, state_sh)
         batch = _placed(batch, batch_sh)
         with active_mesh(mesh):
