@@ -406,6 +406,19 @@ def _flash_fwd(q, k, v, kvmask, seed, causal, scale, block_q, block_k,
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, rate,
                res, g):
+    return _bwd_core(causal, scale, block_q, block_k, interpret, has_mask,
+                     rate, res, g, dlse=None)
+
+
+def _bwd_core(causal, scale, block_q, block_k, interpret, has_mask, rate,
+              res, g, dlse):
+    """Shared backward for _flash (dlse=None) and _flash_lse.
+
+    The lse cotangent needs NO kernel change: d(lse)/d(s_ij) = p_ij, and
+    both kernels compute ``ds = p * (dp - delta)`` — so folding the lse
+    cotangent in is exactly ``delta -= dlse`` on the per-row delta
+    operand.
+    """
     qt, kt, vt, kvm, kvmask, seed, o, lse = res
     b, h, sq_p, d = qt.shape
     skv_p = kt.shape[2]
@@ -421,6 +434,10 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, rate,
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )[:, :, None, :]
+    if dlse is not None:
+        delta = delta - jnp.pad(
+            dlse.astype(jnp.float32), ((0, 0), (0, 0), (0, sq_p - sq))
+        )[:, :, None, :]
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda b, h, i, j: (b, h, i, 0),
                           memory_space=pltpu.VMEM)
@@ -486,6 +503,109 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, has_mask, rate,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _prep_call(q, k, mask, scale, dropout_rate, dropout_rng, interpret):
+    """Shared entry preamble for flash_attention / flash_attention_with_lse
+    (ONE place for the scale/interpret defaults, the dropout contract, the
+    seed derivation, and kv-mask normalization — the two public entry
+    points must not drift)."""
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = _interpret_default()
+
+    from tpudl.ops.attention import normalize_kv_mask
+
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires a dropout_rng")
+        if interpret:
+            raise NotImplementedError(
+                "flash_attention dropout draws from the TPU hardware PRNG, "
+                "which interpret mode does not implement — run on TPU or "
+                "set dropout_rate=0"
+            )
+        seed = jax.random.bits(dropout_rng, (2,), jnp.uint32)
+    else:
+        seed = jnp.zeros((2,), jnp.uint32)
+
+    kvmask = normalize_kv_mask(
+        mask, b, skv, dtype=jnp.float32, impl="flash_attention"
+    )
+    return kvmask, seed, scale, interpret, mask is not None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_lse(q, k, v, kvmask, seed, causal, scale, block_q, block_k,
+               interpret, has_mask, rate):
+    o, lse, _ = _fwd(q, k, v, kvmask, seed, causal, scale, block_q, block_k,
+                     interpret, has_mask, rate)
+    return (
+        o[:, :, : q.shape[1], :].transpose(0, 2, 1, 3),
+        lse[:, :, 0, : q.shape[1]],
+    )
+
+
+def _flash_lse_fwd(q, k, v, kvmask, seed, causal, scale, block_q, block_k,
+                   interpret, has_mask, rate):
+    o, lse, (qt, kt, vt, kvm) = _fwd(
+        q, k, v, kvmask, seed, causal, scale, block_q, block_k, interpret,
+        has_mask, rate,
+    )
+    out = (
+        o[:, :, : q.shape[1], :].transpose(0, 2, 1, 3),
+        lse[:, :, 0, : q.shape[1]],
+    )
+    return out, (qt, kt, vt, kvm, kvmask, seed, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, has_mask,
+                   rate, res, g):
+    do, dlse = g
+    return _bwd_core(causal, scale, block_q, block_k, interpret, has_mask,
+                     rate, res, do, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """flash_attention that ALSO returns the per-query logsumexp
+    ([B, H, Sq] f32) — the statistic a distributed-softmax caller needs
+    to merge partial attention results across kv blocks, e.g. the
+    flash-bodied ring attention (tpudl.ops.ring_attention): combined
+    output = sum_t o_t * exp(lse_t - logsumexp_t lse_t). Differentiable
+    in BOTH outputs (the lse cotangent folds into the backward's delta
+    operand — see _bwd_core). Fully-masked query rows report
+    lse = MASK_VALUE (an exact zero weight in any merge).
+
+    Under dropout the returned lse is of the UNDROPPED distribution
+    (dropout acts after normalization — the kernel's factorization), so
+    merge weights are dropout-independent: exactly the distributed
+    semantics tpudl.ops.ring_attention's exact-dropout contract needs.
+    """
+    kvmask, seed, scale, interpret, has_mask = _prep_call(
+        q, k, mask, scale, dropout_rate, dropout_rng, interpret
+    )
+    return _flash_lse(
+        q, k, v, kvmask, seed, causal, scale, block_q, block_k, interpret,
+        has_mask, float(dropout_rate),
+    )
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -513,33 +633,9 @@ def flash_attention(
     cannot afford (its mask alone is O(S^2) HBM). TPU-only: raises under
     interpret mode, which has no hardware PRNG.
     """
-    b, sq, h, d = q.shape
-    skv = k.shape[1]
-    if scale is None:
-        scale = d ** -0.5
-    if interpret is None:
-        interpret = _interpret_default()
-
-    from tpudl.ops.attention import normalize_kv_mask
-
-    if dropout_rate > 0.0:
-        if dropout_rng is None:
-            raise ValueError("dropout_rate > 0 requires a dropout_rng")
-        if interpret:
-            raise NotImplementedError(
-                "flash_attention dropout draws from the TPU hardware PRNG, "
-                "which interpret mode does not implement — run on TPU or "
-                "set dropout_rate=0"
-            )
-        seed = jax.random.bits(dropout_rng, (2,), jnp.uint32)
-    else:
-        seed = jnp.zeros((2,), jnp.uint32)
-
-    has_mask = mask is not None
-    kvmask = normalize_kv_mask(
-        mask, b, skv, dtype=jnp.float32, impl="flash_attention"
+    kvmask, seed, scale, interpret, has_mask = _prep_call(
+        q, k, mask, scale, dropout_rate, dropout_rng, interpret
     )
-
     return _flash(
         q, k, v, kvmask, seed, causal, scale, block_q, block_k, interpret,
         has_mask, float(dropout_rate),
