@@ -48,12 +48,12 @@ def _batches(n, batch=16, seq=16, seed=5):
 
 
 def _train(mesh, steps=6, cfg=None, distinct_batches=2,
-           param_fsdp=False):
+           param_fsdp=False, num_stages=4, virtual_stages=1):
     from tpudl.parallel.pipelined_bert import PIPELINED_BERT_FSDP_RULES
 
     model = PipelinedBertClassifier(
-        cfg or CFG, num_stages=4, num_microbatches=4,
-        param_fsdp=param_fsdp,
+        cfg or CFG, num_stages=num_stages, num_microbatches=4,
+        param_fsdp=param_fsdp, virtual_stages=virtual_stages,
     )
     state = create_train_state(
         jax.random.key(0),
@@ -252,3 +252,50 @@ def test_pp_fsdp_state_sharded_over_both_axes():
     leaf = kernels[0]
     shard_size = leaf.addressable_shards[0].data.size
     assert shard_size * 8 == leaf.size, (shard_size, leaf.size)
+
+
+def test_interleaved_pp2_v2_training_matches_pp1():
+    """virtual_stages=2 on a pp=2 mesh (4 layers as 4 round-robin chunks,
+    2 per device): the interleaved schedule's losses equal the pp=1
+    sequential fold step for step (dropout off), and it learns — the
+    lower-bubble schedule is drivable through the SAME train stack."""
+    pp1, _, _ = _train(
+        make_mesh(MeshSpec(dp=-1, pp=1)), steps=10, cfg=NODROP,
+        num_stages=1, virtual_stages=4,
+    )
+    ppi, _, _ = _train(
+        make_mesh(MeshSpec(dp=2, fsdp=2, sp=1, tp=1, pp=2)), steps=10,
+        cfg=NODROP, num_stages=2, virtual_stages=2,
+    )
+    np.testing.assert_allclose(ppi[0], pp1[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ppi, pp1, rtol=1e-3, atol=1e-3)
+    assert min(ppi[-2:]) < ppi[0]
+
+
+def test_interleaved_trains_with_dropout_and_shards_over_pp():
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, sp=1, tp=1, pp=2))
+    losses_a, step, _ = _train(mesh, steps=8, num_stages=2,
+                               virtual_stages=2)
+    losses_b, _, _ = _train(mesh, steps=8, num_stages=2, virtual_stages=2)
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-6)
+    assert min(losses_a[-2:]) < losses_a[0]
+    pp_sharded = [
+        _path_str(path)
+        for path, sh in jax.tree_util.tree_leaves_with_path(
+            step.state_shardings
+        )
+        if "pp" in str(sh.spec)
+    ]
+    assert any("stages" in p and "params" in p for p in pp_sharded)
+    assert any("opt_state" in p for p in pp_sharded)
+
+
+def test_interleaved_validates():
+    import pytest
+
+    with pytest.raises(ValueError, match="param_fsdp"):
+        PipelinedBertClassifier(CFG, num_stages=2, num_microbatches=2,
+                                param_fsdp=True, virtual_stages=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        PipelinedBertClassifier(CFG, num_stages=2, num_microbatches=2,
+                                virtual_stages=3)

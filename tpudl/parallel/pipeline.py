@@ -113,7 +113,10 @@ def num_ticks(num_stages: int, num_microbatches: int) -> int:
 
 
 def schedule_stats(
-    num_stages: int, num_microbatches: int, schedule: str = "gpipe"
+    num_stages: int,
+    num_microbatches: int,
+    schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> dict:
     """Tick/bubble/memory accounting for a pipeline schedule — the
     numbers a capacity plan needs, reported instead of assumed
@@ -129,23 +132,83 @@ def schedule_stats(
       interleaving bounds it by pipeline DEPTH, ``min(S, M)``: the
       reason to reach for 1F1B when activation memory, not compute, is
       the binding constraint.
+
+    ``schedule="interleaved"`` (``pipeline_interleaved``) is the one
+    schedule that genuinely SHRINKS the bubble: ``num_stages`` total
+    virtual stages spread v = ``virtual_stages`` per device over
+    n = S/v devices run ``M*v + n - 1`` chunk-sized ticks, so the
+    bubble fraction is (n-1)/(M*v + n-1) — fill amortizes over
+    chunk (1/v stage) ticks — at v times the activation-hop traffic.
     """
     s, m = num_stages, num_microbatches
-    ticks = 2 * num_ticks(s, m)
     stats = {
         "schedule": schedule,
         "num_stages": s,
         "num_microbatches": m,
-        "ticks": ticks,
-        "bubble_fraction": (s - 1) / (m + s - 1),
     }
     if schedule == "gpipe":
+        stats["ticks"] = 2 * num_ticks(s, m)
+        stats["bubble_fraction"] = (s - 1) / (m + s - 1)
         stats["stored_microbatch_inputs"] = m + s - 1
     elif schedule == "1f1b":
+        stats["ticks"] = 2 * num_ticks(s, m)
+        stats["bubble_fraction"] = (s - 1) / (m + s - 1)
         stats["stored_microbatch_inputs"] = min(s, m)
+    elif schedule == "interleaved":
+        if s % virtual_stages:
+            raise ValueError(
+                f"{s} stages not divisible by virtual_stages={virtual_stages}"
+            )
+        n_dev = s // virtual_stages
+        t1 = m * virtual_stages + n_dev - 1
+        stats["virtual_stages"] = virtual_stages
+        stats["num_devices"] = n_dev
+        stats["ticks"] = 2 * t1  # chunk-sized (1/v stage) ticks
+        stats["bubble_fraction"] = (n_dev - 1) / t1
+        stats["stored_microbatch_inputs"] = t1
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
     return stats
+
+
+def _prepare_microbatches(
+    x: Any, num_microbatches: int, mesh, batch_spec: P, axis_name: str
+):
+    """Shared schedule prologue: validate the batch pytree, check
+    microbatch/batch_spec divisibility, and reshape to [M, mb, ...] with
+    matching shard_map specs. ONE implementation for every pipeline
+    schedule (gpipe/interleaved) — the validation and reshape rules must
+    not drift between them."""
+    leaves = jax.tree.leaves(x)
+    batch = leaves[0].shape[0]
+    if any(l.shape[0] != batch for l in leaves):
+        raise ValueError(
+            f"all x leaves must share the batch dim; got "
+            f"{[l.shape for l in leaves]}"
+        )
+    if batch % num_microbatches != 0:
+        raise ValueError(
+            f"batch {batch} not divisible by num_microbatches="
+            f"{num_microbatches}"
+        )
+    mb = batch // num_microbatches
+    n_batch_shards = 1
+    for entry in batch_spec:
+        for ax in entry if isinstance(entry, tuple) else (entry,):
+            n_batch_shards *= mesh.shape[ax]
+    if mb % n_batch_shards != 0:
+        raise ValueError(
+            f"microbatch size {mb} (batch {batch} / num_microbatches="
+            f"{num_microbatches}) not divisible by the {batch_spec} mesh "
+            f"extent {n_batch_shards}"
+        )
+    xm = jax.tree.map(
+        lambda a: a.reshape((num_microbatches, mb) + a.shape[1:]), x
+    )
+    x_specs = jax.tree.map(
+        lambda a: P(None, *batch_spec, *([None] * (a.ndim - 2))), xm
+    )
+    return batch, xm, x_specs
 
 
 def _pipeline_local(
@@ -287,37 +350,14 @@ def pipeline(
             y = stage_fn(jax.tree.map(lambda p: p[i], stacked_params), y)
         return y
 
-    leaves = jax.tree.leaves(x)
-    batch = leaves[0].shape[0]
-    if any(l.shape[0] != batch for l in leaves):
-        raise ValueError(
-            f"all x leaves must share the batch dim; got "
-            f"{[l.shape for l in leaves]}"
-        )
-    if batch % num_microbatches != 0:
-        raise ValueError(
-            f"batch {batch} not divisible by num_microbatches={num_microbatches}"
-        )
     leading = jax.tree.leaves(stacked_params)[0].shape[0]
     if leading != n_stages:
         raise ValueError(
             f"stacked_params leading dim {leading} != mesh {axis_name} size "
             f"{n_stages} (one stage per pp slot)"
         )
-
-    mb = batch // num_microbatches
-    n_batch_shards = 1
-    for entry in batch_spec:
-        for ax in entry if isinstance(entry, tuple) else (entry,):
-            n_batch_shards *= mesh.shape[ax]
-    if mb % n_batch_shards != 0:
-        raise ValueError(
-            f"microbatch size {mb} (batch {batch} / num_microbatches="
-            f"{num_microbatches}) not divisible by the {batch_spec} mesh "
-            f"extent {n_batch_shards}"
-        )
-    xm = jax.tree.map(
-        lambda a: a.reshape((num_microbatches, mb) + a.shape[1:]), x
+    batch, xm, x_specs = _prepare_microbatches(
+        x, num_microbatches, mesh, batch_spec, axis_name
     )
 
     fsdp_dims = None
@@ -339,10 +379,6 @@ def pipeline(
         param_specs = jax.tree.map(
             lambda p: stage_param_spec(p.ndim, axis_name), stacked_params
         )
-    # Microbatched input: the original batch dim is now dim 1.
-    x_specs = jax.tree.map(
-        lambda a: P(None, *batch_spec, *([None] * (a.ndim - 2))), xm
-    )
 
     fn = jax.shard_map(
         partial(
@@ -607,3 +643,200 @@ def pipeline_1f1b(
         check_vma=False,
     )
     return fn(stacked_params, xm, tm)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) GPipe schedule.
+# ---------------------------------------------------------------------------
+
+
+def interleave_stage_order(num_stages: int, num_devices: int) -> list:
+    """Storage order for ``pipeline(..., virtual_stages=v)``: row
+    ``d*v + c`` must hold pipeline stage ``c*num_devices + d`` (device d
+    owns the round-robin stages {d, d+n, d+2n, ...}; a contiguous
+    P('pp') shard of the stacked tree then lands exactly those rows on
+    device d). Apply to the per-stage list BEFORE stack_pytrees:
+
+        order = interleave_stage_order(S, n)
+        stacked = stack_pytrees([stages[i] for i in order])
+    """
+    if num_stages % num_devices:
+        raise ValueError(
+            f"{num_stages} stages not divisible by {num_devices} devices"
+        )
+    v = num_stages // num_devices
+    return [c * num_devices + d for d in range(num_devices) for c in range(v)]
+
+
+def _pipeline_local_interleaved(
+    params: Any,
+    x: Any,
+    *,
+    stage_fn: Callable[[Any, Any], Any],
+    axis_name: str,
+    num_microbatches: int,
+    virtual_stages: int,
+):
+    """Per-device interleaved GPipe. Each device holds ``v`` stage chunks
+    (rows of its [v, ...] param block = round-robin stages d, d+n, ...);
+    a microbatch laps the ring v times. Schedule (tick t, device d,
+    r = t - d): microbatches run in groups of n; within group g, chunk c,
+    slot i (r = g*n*v + c*n + i), device d runs chunk c of microbatch
+    g*n + i. Every dependency is exactly one tick old, so ticks total
+    M*v + n - 1 — each tick is 1/v of a GPipe stage, so the bubble
+    fraction drops from (n-1)/(M+n-1) to (n-1)/(M*v + n-1)
+    (schedule_stats). Communication scales with v (one full-activation
+    ppermute hop per chunk instead of per stage) — the standard
+    interleaving trade; it rides the same neighbor ICI links.
+    """
+    n = jax.lax.psum(1, axis_name)
+    d_idx = jax.lax.axis_index(axis_name)
+    first = d_idx == 0
+    last = d_idx == n - 1
+    m, v = num_microbatches, virtual_stages
+
+    # [v, ...] local block: row c = this device's chunk c.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    out0 = jax.tree.map(jnp.zeros_like, x)
+    carry0 = jax.tree.map(lambda a: jnp.zeros(a.shape[1:], a.dtype), x)
+
+    def tick(carry, t):
+        carry_in, out = carry
+        r = t - d_idx
+        active = (r >= 0) & (r < m * v)
+        rem = r % (n * v)
+        c = jnp.clip(rem // n, 0, v - 1)
+        mb_i = jnp.clip((r // (n * v)) * n + rem % n, 0, m - 1)
+
+        stage_params = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            params,
+        )
+        mb = jax.tree.map(lambda a: a[mb_i], x)
+        take_input = first & (c == 0)
+        stage_in = jax.tree.map(
+            lambda a, b: jnp.where(take_input, a, b), mb, carry_in
+        )
+        y = stage_fn(stage_params, stage_in)
+
+        write_valid = active & last & (c == v - 1)
+
+        def write(buf, val):
+            prev = jax.lax.dynamic_index_in_dim(buf, mb_i, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(write_valid, val, prev), mb_i, 0
+            )
+
+        out = jax.tree.map(write, out, y)
+        carry_next = jax.lax.ppermute(y, axis_name, perm)
+        return (carry_next, out), None
+
+    total = m * v + n - 1
+    (_, out), _ = jax.lax.scan(tick, (carry0, out0), jnp.arange(total))
+    return jax.tree.map(
+        lambda o: jax.lax.psum(
+            jnp.where(last, o, jnp.zeros_like(o)), axis_name
+        ),
+        out,
+    )
+
+
+def pipeline_interleaved(
+    stage_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    x: Any,
+    *,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = AXIS_PIPE,
+    batch_spec: P = P(),
+    virtual_stages: Optional[int] = None,
+) -> Any:
+    """Interleaved virtual-stage pipeline forward (reverse-differentiable
+    like ``pipeline`` — autodiff replays the ring transposed).
+
+    Pass ``virtual_stages`` (the v the storage order was built for —
+    interleave_stage_order(S, S // v)) whenever you have it: the
+    storage permutation is MESH-DEPENDENT, and running a tree stacked
+    for one pp extent on another would silently apply layers out of
+    order — with it, the mismatch raises instead.
+
+    ``stacked_params`` has leading dim ``num_stages = n * v`` in
+    INTERLEAVED storage order (``interleave_stage_order``): row
+    ``d*v + c`` is pipeline stage ``c*n + d``. ``num_microbatches`` must
+    be a multiple of the pp extent (the schedule runs groups of n). With
+    v = stages/devices > 1 the bubble fraction is (n-1)/(M*v + n-1) —
+    the fill/drain cost amortizes over chunk-sized (1/v stage) ticks —
+    at v times the activation-hop communication volume. v = 1 is exactly
+    GPipe; use ``pipeline`` for it (this function permits it but pays
+    the dynamic chunk indexing).
+
+    Without a mesh (or pp=1): sequential fold over stages in PIPELINE
+    order, numerically identical.
+    """
+    from tpudl.parallel.sharding import current_mesh
+
+    if mesh is None:
+        mesh = current_mesh()
+    n_stages_total = jax.tree.leaves(stacked_params)[0].shape[0]
+    n = mesh.shape[axis_name] if mesh is not None else 1
+    if virtual_stages is not None and n > 1:
+        if n_stages_total != n * virtual_stages:
+            raise ValueError(
+                f"stacked_params was built for virtual_stages="
+                f"{virtual_stages} ({n_stages_total} chunks over "
+                f"{n_stages_total // virtual_stages} devices), but the mesh "
+                f"{axis_name} extent is {n} — the interleaved storage "
+                f"order would scramble the layer order"
+            )
+    if n == 1:
+        # Sequential fold in PIPELINE order. The storage permutation
+        # depends on the mesh the tree was built for; with
+        # virtual_stages given we can invert it, otherwise identity
+        # storage is assumed (v==1 trees).
+        if virtual_stages is not None and virtual_stages > 1:
+            order = interleave_stage_order(
+                n_stages_total, n_stages_total // virtual_stages
+            )
+            rows = [order.index(c) for c in range(n_stages_total)]
+        else:
+            rows = list(range(n_stages_total))
+        y = x
+        for row in rows:
+            y = stage_fn(jax.tree.map(lambda p: p[row], stacked_params), y)
+        return y
+    if n_stages_total % n:
+        raise ValueError(
+            f"stacked_params leading dim {n_stages_total} not divisible by "
+            f"mesh {axis_name} size {n}"
+        )
+    v = n_stages_total // n
+    if num_microbatches % n:
+        raise ValueError(
+            f"num_microbatches={num_microbatches} must be a multiple of the "
+            f"{axis_name} extent {n} (the interleaved schedule runs groups "
+            f"of n)"
+        )
+    batch, xm, x_specs = _prepare_microbatches(
+        x, num_microbatches, mesh, batch_spec, axis_name
+    )
+    param_specs = jax.tree.map(
+        lambda p: stage_param_spec(p.ndim, axis_name), stacked_params
+    )
+
+    fn = jax.shard_map(
+        partial(
+            _pipeline_local_interleaved,
+            stage_fn=stage_fn,
+            axis_name=axis_name,
+            num_microbatches=num_microbatches,
+            virtual_stages=v,
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, x_specs),
+        out_specs=x_specs,
+        check_vma=False,
+    )
+    out = fn(stacked_params, xm)
+    return jax.tree.map(lambda a: a.reshape((batch,) + a.shape[2:]), out)

@@ -47,7 +47,9 @@ from tpudl.models.bert import BertConfig, BertEmbeddings, BertLayer, _dense
 from tpudl.ops.attention import padding_mask
 from tpudl.ops.dropout import Dropout
 from tpudl.parallel.pipeline import (
+    interleave_stage_order,
     pipeline,
+    pipeline_interleaved,
     stack_pytrees,
     stage_param_spec,
     stage_param_spec_fsdp,
@@ -104,16 +106,42 @@ class PipelinedBertClassifier:
         num_stages: int,
         num_microbatches: int,
         param_fsdp: bool = False,
+        virtual_stages: int = 1,
     ):
-        if cfg.num_layers % num_stages != 0:
+        """``virtual_stages`` > 1 switches to the interleaved schedule
+        (tpudl.parallel.pipeline.pipeline_interleaved): ``num_stages``
+        remains the pp mesh extent, each device holds ``v`` round-robin
+        chunks of layers (num_stages*v chunks total, stored in
+        interleave_stage_order so the contiguous pp shard lands each
+        device's chunks locally), and the bubble fraction drops from
+        (n-1)/(M+n-1) to (n-1)/(M*v + n-1) at v times the
+        activation-hop traffic. Not composable with param_fsdp (the
+        interleaved kernel does not thread the in-body all-gather)."""
+        if virtual_stages < 1:
+            raise ValueError(f"virtual_stages must be >= 1, got {virtual_stages}")
+        if virtual_stages > 1 and param_fsdp:
+            raise ValueError(
+                "virtual_stages > 1 does not compose with param_fsdp"
+            )
+        n_chunks = num_stages * virtual_stages
+        if cfg.num_layers % n_chunks != 0:
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by "
-                f"num_stages {num_stages}"
+                f"num_stages*virtual_stages {n_chunks}"
             )
         self.cfg = cfg
         self.num_stages = num_stages
-        self.layers_per_stage = cfg.num_layers // num_stages
+        self.virtual_stages = virtual_stages
+        self.num_chunks = n_chunks
+        self.layers_per_stage = cfg.num_layers // n_chunks
         self.num_microbatches = num_microbatches
+        #: storage row j holds pipeline chunk _chunk_order[j]
+        #: (identity when virtual_stages == 1).
+        self._chunk_order = (
+            interleave_stage_order(n_chunks, num_stages)
+            if virtual_stages > 1
+            else list(range(n_chunks))
+        )
         #: pp x fsdp composition (strategy="pp+fsdp"): shard the
         #: TrainState with PIPELINED_BERT_FSDP_RULES so stage weights +
         #: optimizer moments live 1/(pp*fsdp); the pipeline all-gathers
@@ -138,12 +166,19 @@ class PipelinedBertClassifier:
         layer_params = [
             layer.init(k, x, mask4, False)["params"] for k in layer_keys
         ]
-        stacked = jax.tree.map(
-            lambda a: a.reshape(
-                (self.num_stages, self.layers_per_stage) + a.shape[1:]
-            ),
-            stack_pytrees(layer_params),
-        )
+        # Group consecutive layers into chunks, then stack chunks in
+        # STORAGE order (interleaved for virtual_stages > 1, so the
+        # contiguous pp shard puts each device's round-robin chunks in
+        # its local block).
+        chunks = [
+            stack_pytrees(
+                layer_params[
+                    c * self.layers_per_stage:(c + 1) * self.layers_per_stage
+                ]
+            )
+            for c in self._chunk_order
+        ]
+        stacked = stack_pytrees(chunks)
         pooler = _dense(cfg, cfg.hidden_size, "pooler").init(
             r_pool, x[:, 0]
         )["params"]
@@ -231,20 +266,26 @@ class PipelinedBertClassifier:
 
         mesh = current_mesh()
         n_pp = mesh.shape["pp"] if mesh is not None else 1
+        # Storage row of each pipeline chunk (identity for v == 1).
+        row_of_chunk = [
+            self._chunk_order.index(c) for c in range(self.num_chunks)
+        ]
         if n_pp == 1:
             # Degenerate path: no pipeline, but the SAME per-microbatch
             # structure (a lax.map over microbatches) so dropout keys —
             # and therefore training trajectories — match pp>1 exactly.
             # All BERT ops are per-example, so the split itself is
-            # numerically free.
+            # numerically free. Chunks walk in PIPELINE order through
+            # the (possibly interleaved) storage rows.
             stacked = stages["layers"]
 
             def run_mb(args):
                 h, m4, kd = args
-                for s in range(self.num_stages):
+                for c in range(self.num_chunks):
+                    row = row_of_chunk[c]
                     for j in range(lps):
-                        lp = jax.tree.map(lambda a: a[s, j], stacked)
-                        h = run_layer(lp, h, m4, kd, s * lps + j)
+                        lp = jax.tree.map(lambda a: a[row, j], stacked)
+                        h = run_layer(lp, h, m4, kd, c * lps + j)
                 return h
 
             mb = batch // m
@@ -264,27 +305,44 @@ class PipelinedBertClassifier:
                     h = run_layer(lp, h, m4, krow[0], sid * lps + j)
                 return h, m4, krow
 
+            # The chunk id rides the stacked tree (storage order), so
+            # each stage body knows its GLOBAL layer offset regardless
+            # of which storage row the schedule handed it.
+            stacked_with_id = {
+                "layers": stages["layers"],
+                "stage_id": jnp.asarray(self._chunk_order, jnp.int32),
+            }
             # constrain() must no-op inside the shard_map body (the mesh
             # axes are manual there); pipeline gets the mesh explicitly.
             with active_mesh(None):
-                x, _, _ = pipeline(
-                    stage_fn,
-                    {
-                        "layers": stages["layers"],
-                        "stage_id": jnp.arange(
-                            self.num_stages, dtype=jnp.int32
-                        ),
-                    },
-                    (x, mask4, key_rows),
-                    num_microbatches=m,
-                    mesh=mesh,
-                    # fsdp stays a DATA axis (ZeRO semantics): the batch
-                    # splits over (dp, fsdp) while param_fsdp shards the
-                    # WEIGHTS over fsdp too — the all-gather transpose
-                    # reduce-scatters each shard's gradient contribution.
-                    batch_spec=P(("dp", "fsdp")),
-                    param_fsdp=self.param_fsdp,
-                )
+                if self.virtual_stages > 1:
+                    x, _, _ = pipeline_interleaved(
+                        stage_fn,
+                        stacked_with_id,
+                        (x, mask4, key_rows),
+                        num_microbatches=m,
+                        mesh=mesh,
+                        batch_spec=P(("dp", "fsdp")),
+                        # The storage order was built for THIS v; a
+                        # different pp extent raises instead of silently
+                        # scrambling the layer order.
+                        virtual_stages=self.virtual_stages,
+                    )
+                else:
+                    x, _, _ = pipeline(
+                        stage_fn,
+                        stacked_with_id,
+                        (x, mask4, key_rows),
+                        num_microbatches=m,
+                        mesh=mesh,
+                        # fsdp stays a DATA axis (ZeRO semantics): the
+                        # batch splits over (dp, fsdp) while param_fsdp
+                        # shards the WEIGHTS over fsdp too — the
+                        # all-gather transpose reduce-scatters each
+                        # shard's gradient contribution.
+                        batch_spec=P(("dp", "fsdp")),
+                        param_fsdp=self.param_fsdp,
+                    )
 
         x = constrain(x, ("dp", "fsdp"), "sp", "tp")
         pooled = jnp.tanh(
