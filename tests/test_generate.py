@@ -200,3 +200,27 @@ def test_top_k_and_top_p_truncation(model_and_params):
         generate(model, params, prompt, max_new_tokens=1, top_k=50)
     with pytest.raises(ValueError, match="temperature > 0"):
         generate(model, params, prompt, max_new_tokens=1, top_p=0.9)
+
+
+def test_sampling_hyperparams_do_not_recompile_decode(model_and_params):
+    """temperature/top_p/eos_id ride as traced scalars: varying them
+    reuses the ONE compiled decode scan (a serving process must not pay
+    a model-sized compile per request's sampling config)."""
+    from tpudl.models.generate import _decode_chunk
+
+    model, params = model_and_params
+    prompt = jax.random.randint(jax.random.key(60), (B, S), 1, CFG.vocab_size)
+    before = _decode_chunk._cache_size()
+    for temp, tp in [(0.7, 0.9), (0.8, 0.95), (1.3, 0.5)]:
+        generate(model, params, prompt, max_new_tokens=9, temperature=temp,
+                 top_p=tp, eos_id=3, rng=jax.random.key(61))
+    added = _decode_chunk._cache_size() - before
+    # At most the chunk length and the remainder length compile once each.
+    assert added <= 2, added
+
+
+def test_generate_rejects_zero_tokens(model_and_params):
+    model, params = model_and_params
+    prompt = jnp.ones((B, S), jnp.int32)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, params, prompt, max_new_tokens=0)
