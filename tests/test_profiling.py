@@ -1,0 +1,111 @@
+"""Trace-summary tool (tpudl.train.profiling) against a synthetic trace
+in the exact plugins/profile layout jax.profiler.trace writes, plus an
+end-to-end capture through fit()'s profiling hook on the CPU backend."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+
+from tpudl.train.profiling import format_summary, summarize_trace
+
+
+def _write_trace(tmp_path, events):
+    run = tmp_path / "plugins" / "profile" / "2026_07_31"
+    run.mkdir(parents=True)
+    path = run / "host.trace.json.gz"
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def _meta(pid, name):
+    return {"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": name}}
+
+
+def _op(pid, tid, name, dur_us, cat, flops=0, bytes_=0):
+    return {
+        "ph": "X", "pid": pid, "tid": tid, "ts": 0.0, "dur": dur_us,
+        "name": name,
+        "args": {
+            "hlo_category": cat,
+            "model_flops": str(flops),
+            "bytes_accessed": str(bytes_),
+        },
+    }
+
+
+def test_summarize_synthetic_trace(tmp_path):
+    events = [
+        _meta(3, "/device:TPU:0"),
+        _meta(7, "/host:CPU"),
+        # op stream (tid 3): 2 matmuls + 1 pointwise, over 2 steps
+        _op(3, 3, "fusion.1", 1000.0, "convolution fusion",
+            flops=100e9, bytes_=50e6),
+        _op(3, 3, "fusion.1", 1000.0, "convolution fusion",
+            flops=100e9, bytes_=50e6),
+        _op(3, 3, "fusion.2", 500.0, "loop fusion", bytes_=400e6),
+        _op(3, 3, "fusion.2", 500.0, "loop fusion", bytes_=400e6),
+        # aggregate launch span on another tid must be ignored
+        _op(3, 1, "jit_step", 3000.0, "?"),
+        # host events must be ignored
+        _op(7, 1, "python", 9999.0, "?"),
+    ]
+    root = _write_trace(tmp_path, events)
+    s = summarize_trace(root, steps=2)
+    assert s["num_events"] == 4
+    np.testing.assert_allclose(s["total_ms_per_step"], 1.5)
+    conv = s["by_category"]["convolution fusion"]
+    np.testing.assert_allclose(conv["ms_per_step"], 1.0)
+    np.testing.assert_allclose(conv["share"], 2.0 / 3.0)
+    # 200 GFLOP over 2000 us = 100 TF/s
+    np.testing.assert_allclose(conv["tflops"], 100.0)
+    lf = s["by_category"]["loop fusion"]
+    np.testing.assert_allclose(lf["gbps"], 800.0)  # 800 MB / 1000 us
+    assert s["top_ops"][0]["name"] == "fusion.1"
+    txt = format_summary(s)
+    assert "convolution fusion" in txt and "fusion.1" in txt
+
+
+def test_fit_profile_hook_roundtrip(tmp_path):
+    """fit(profile_dir=...) -> summarize_trace on the CPU backend: the
+    whole capture-to-analysis loop works without TensorBoard."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import pytest
+
+    from tpudl.data.synthetic import synthetic_classification_batches
+    from tpudl.models.resnet import ResNetTiny
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        fit,
+        make_classification_train_step,
+    )
+
+    model = ResNetTiny(num_classes=4)
+    state = create_train_state(
+        jax.random.key(0), model, jnp.zeros((1, 16, 16, 3)),
+        optax.sgd(0.05),
+    )
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(make_classification_train_step(), mesh, state, None)
+    prof = str(tmp_path / "prof")
+    fit(
+        step, state,
+        synthetic_classification_batches(
+            16, image_shape=(16, 16, 3), num_classes=4, num_batches=6
+        ),
+        jax.random.key(1),
+        profile_dir=prof, profile_window=(2, 5),
+    )
+    try:
+        s = summarize_trace(prof, steps=3, device_substr="cpu")
+    except (FileNotFoundError, ValueError) as e:  # pragma: no cover
+        pytest.skip(f"CPU trace lacks device events here: {e}")
+    assert s["total_ms_per_step"] > 0
+    assert s["by_category"]

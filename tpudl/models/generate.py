@@ -171,8 +171,8 @@ def _select_impl(logits, rng, temperature, top_k=None, top_p=None,
     top_p keeps the smallest prefix of probability-sorted tokens whose
     cumulative mass reaches p (the argmax always survives). Parameter
     combinations are checked once by validate_sampling, not per step.
-    Traced inside _decode_chunk's scan; the jitted alias below serves
-    the one prefill-token selection. ``greedy`` makes the structural
+    Traced inside _decode_chunk's scan; _select_first serves the one
+    prefill-token selection. ``greedy`` makes the structural
     branch explicit when ``temperature`` is a traced scalar (a tracer
     cannot drive the ``== 0.0`` Python branch); None = derive from the
     concrete temperature. top_k (a shape) must be concrete; top_p may
@@ -180,6 +180,12 @@ def _select_impl(logits, rng, temperature, top_k=None, top_p=None,
     """
     if greedy is None:
         greedy = temperature == 0.0
+    # Selection math in f32 regardless of model dtype: a 128k-vocab bf16
+    # cumsum has ~3-digit resolution — comparable to 1-p at top_p=0.95 —
+    # and the scan path's traced f32 scalars would otherwise promote
+    # while the first-token path stayed bf16 (different numerics for
+    # token 0 than tokens 1..N).
+    logits = logits.astype(jnp.float32)
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -206,7 +212,19 @@ def _select_impl(logits, rng, temperature, top_k=None, top_p=None,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-_select = jax.jit(_select_impl, static_argnums=(2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _select_first(logits, rng, greedy, top_k, has_top_p, temperature, top_p):
+    """First-token (prefill-logits) selection with the SAME
+    static/traced split as _decode_chunk: only structure is static, so
+    per-request temperature/top_p reuse one compiled program instead of
+    recompiling the full-vocab sort per float tuple."""
+    return _select_impl(
+        logits, rng,
+        0.0 if greedy else temperature,
+        top_k,
+        top_p if has_top_p else None,
+        greedy=greedy,
+    )
 
 
 def generate(
@@ -228,7 +246,7 @@ def generate(
     S + max_new_tokens. Ragged prompts batch via LEFT-padding: pad short
     rows on the left and pass ``attention_mask`` (0 = pad); each row then
     generates exactly what it would unpadded. ``temperature``/``top_k``/
-    ``top_p`` select the sampling rule (see ``_select``). Returns
+    ``top_p`` select the sampling rule (see ``_select_impl``). Returns
     [B, max_new_tokens] generated ids (after ``eos_id``, positions are
     padded with eos). ``eos_check_every`` paces the all-rows-done
     early-exit readback (1 = check every token).
@@ -262,32 +280,38 @@ def generate(
 
     done = jnp.zeros((b,), bool)
     rng, sel_rng = jax.random.split(rng)
-    token = _select(logits, sel_rng, temperature, top_k, top_p)
+    greedy = temperature == 0.0
+    t_op = jnp.float32(temperature)
+    p_op = jnp.float32(top_p if top_p is not None else 1.0)
+    token = _select_first(
+        logits, sel_rng, greedy, top_k, top_p is not None, t_op, p_op
+    )
     if eos_id is not None:
         token, done = _eos_update(token, done, eos_id)
     # The decode loop runs as compiled lax.scan CHUNKS of
-    # ``eos_check_every`` tokens (_decode_chunk): one host dispatch and
-    # one done-all readback per chunk instead of ~5 dispatches per token
-    # — the difference between relay-latency-bound and
-    # HBM-bandwidth-bound serving. Without an eos there is nothing to
-    # check, so the whole generation is ONE scan. At most two scan
-    # lengths compile (the chunk and the final remainder).
+    # ``eos_check_every`` tokens (_decode_chunk): one host dispatch per
+    # chunk — and with an eos, one done-all readback per chunk —
+    # instead of ~5 dispatches per token: the difference between
+    # relay-latency-bound and HBM-bandwidth-bound serving. Chunking is
+    # unconditional (without an eos the readback is simply skipped), so
+    # the jit cache holds the chunk-length scan plus one remainder
+    # length per (max_new_tokens - 1) % eos_check_every residue — at
+    # most eos_check_every distinct lengths across all requests, not
+    # one model-sized executable per requested length.
     out = [token[:, None]]
     remaining = max_new_tokens - 1
-    chunk = eos_check_every if eos_id is not None else max(remaining, 1)
+    eos_op = jnp.int32(eos_id if eos_id is not None else 0)
     while remaining > 0:
         if eos_id is not None and bool(done.all()):
             # Every row finished: pad the rest with eos, skip dead steps.
             out.append(jnp.full((b, remaining), eos_id, token.dtype))
             break
-        steps = min(chunk, remaining)
+        steps = min(eos_check_every, remaining)
         cache, token, position, done, rng, toks = _decode_chunk(
-            model, steps, temperature == 0.0, top_k,
+            model, steps, greedy, top_k,
             top_p is not None, eos_id is not None,
             params, cache, token, position, done, rng,
-            jnp.float32(temperature),
-            jnp.float32(top_p if top_p is not None else 1.0),
-            jnp.int32(eos_id if eos_id is not None else 0),
+            t_op, p_op, eos_op,
         )
         out.append(toks.T)
         remaining -= steps

@@ -1,0 +1,197 @@
+"""Op-level trace analysis for the profiler hook's output.
+
+tpudl.train.loop.fit captures steps [a, b) with ``jax.profiler.trace``
+(TPUDL_PROFILE_DIR / profile_dir). The TensorBoard UI is not required to
+read the result: the perfetto JSON the trace writes
+(``plugins/profile/<run>/*.trace.json.gz``) carries per-op device events
+with ``hlo_category``, ``model_flops``, and ``bytes_accessed`` — enough
+to answer the questions that matter on TPU (where does the step go, is
+the MXU fed, is the rest at the HBM roof) without leaving the terminal.
+This module is that analysis as a library + CLI:
+
+    state, m, info = fit(step, state, batches, rng,
+                         profile_dir="/tmp/prof", profile_window=(2, 5))
+    from tpudl.train.profiling import summarize_trace, format_summary
+    print(format_summary(summarize_trace("/tmp/prof", steps=3)))
+
+or ``python -m tpudl.train.profiling /tmp/prof --steps 3``.
+
+It is the tool the round-5 ResNet-50 ceiling analysis and BERT lever
+rejections were done with (BASELINE.md): per-category time shares,
+achieved TFLOP/s against the chip peak, and achieved GB/s against the
+HBM roof.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+from typing import Optional
+
+
+def _find_trace_file(trace_dir: str) -> str:
+    pats = [
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.trace.json.gz"),
+        os.path.join(trace_dir, "*.trace.json.gz"),
+    ]
+    for pat in pats:
+        hits = sorted(glob.glob(pat))
+        if hits:
+            return hits[-1]  # newest run directory sorts last
+    raise FileNotFoundError(
+        f"no *.trace.json.gz under {trace_dir} (expected the "
+        f"plugins/profile/<run>/ layout jax.profiler.trace writes)"
+    )
+
+
+def summarize_trace(
+    trace_dir: str,
+    steps: int = 1,
+    device_substr: str = "TPU",
+    top_n: int = 10,
+) -> dict:
+    """Parse a jax.profiler trace directory into per-category and top-op
+    tables.
+
+    ``steps`` divides every duration (pass the number of steps captured
+    in the profile window). Device events are taken from the FIRST
+    (lowest-pid) process whose name contains ``device_substr`` ("TPU";
+    "cpu" for CPU-backend traces; "TPU:3" for one core of a multi-chip
+    trace), on its op stream.
+
+    Returns ``{"trace_file", "total_ms_per_step", "num_events",
+    "by_category": {cat: {"ms_per_step", "share", "tflops", "gbps"}},
+    "top_ops": [{"name", "category", "ms_per_step", "tflops", "gbps"}]}``.
+    """
+    path = _find_trace_file(trace_dir)
+    with gzip.open(path) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    pids = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    device_pids = sorted(
+        p for p, n in pids.items() if device_substr.lower() in n.lower()
+    )
+    if not device_pids:
+        raise ValueError(
+            f"no process named like {device_substr!r} in {path} "
+            f"(processes: {sorted(pids.values())})"
+        )
+    # ONE device only: on a multi-chip trace every core is its own
+    # process, and tids are only unique per pid — summing across cores
+    # would multiply every duration by the core count. Per-core analysis
+    # = call again with a narrower device_substr (e.g. "TPU:3").
+    pid = device_pids[0]
+    dev = [
+        e for e in events if e.get("ph") == "X" and e.get("pid") == pid
+    ]
+    if not dev:
+        raise ValueError(
+            f"device process {pids[pid]!r} has no complete ('X') events in "
+            f"{path} — did the profile window cover any steps?"
+        )
+    # The op stream is the thread with the most events (other threads
+    # carry aggregate launch spans that would double-count).
+    tid_counts = collections.Counter(e.get("tid") for e in dev)
+    op_tid = tid_counts.most_common(1)[0][0]
+    ops = [e for e in dev if e.get("tid") == op_tid]
+
+    cat = collections.defaultdict(lambda: [0.0, 0, 0.0])
+    per_op = collections.defaultdict(lambda: [0.0, 0, 0.0, "?"])
+    for e in ops:
+        a = e.get("args", {})
+        c = a.get("hlo_category", "?")
+        dur = e["dur"]  # microseconds
+        fl = int(float(a.get("model_flops", 0) or 0))
+        by = float(a.get("bytes_accessed", 0) or 0)
+        cat[c][0] += dur
+        cat[c][1] += fl
+        cat[c][2] += by
+        key = a.get("deduplicated_name") or e["name"]
+        per_op[key][0] += dur
+        per_op[key][1] += fl
+        per_op[key][2] += by
+        per_op[key][3] = c
+
+    total = sum(v[0] for v in cat.values())
+
+    def row(dur, fl, by):
+        return {
+            "ms_per_step": dur / steps / 1e3,
+            "share": dur / total if total else 0.0,
+            "tflops": fl / (dur * 1e-6) / 1e12 if dur else 0.0,
+            "gbps": by / (dur * 1e-6) / 1e9 if dur else 0.0,
+        }
+
+    return {
+        "trace_file": path,
+        "total_ms_per_step": total / steps / 1e3,
+        "num_events": len(ops),
+        "by_category": {
+            c: row(*v)
+            for c, v in sorted(cat.items(), key=lambda kv: -kv[1][0])
+        },
+        "top_ops": [
+            {"name": k, "category": v[3], **row(v[0], v[1], v[2])}
+            for k, v in sorted(per_op.items(), key=lambda kv: -kv[1][0])[
+                :top_n
+            ]
+        ],
+    }
+
+
+def format_summary(summary: dict) -> str:
+    """Human-readable tables for a ``summarize_trace`` result."""
+    lines = [
+        f"trace: {summary['trace_file']}",
+        f"total: {summary['total_ms_per_step']:.2f} ms/step "
+        f"({summary['num_events']} device events)",
+        f"{'category':30} {'ms/step':>9} {'share':>6} {'TF/s':>7} {'GB/s':>7}",
+    ]
+    for c, r in summary["by_category"].items():
+        lines.append(
+            f"{c:30} {r['ms_per_step']:9.2f} {100 * r['share']:5.1f}% "
+            f"{r['tflops']:7.1f} {r['gbps']:7.0f}"
+        )
+    lines.append("top ops:")
+    for r in summary["top_ops"]:
+        lines.append(
+            f"  {r['ms_per_step']:8.2f} ms {r['tflops']:6.1f} TF/s "
+            f"{r['gbps']:6.0f} GB/s  {r['category']:22} {r['name']}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Summarize a jax.profiler trace (per-op-category "
+        "time / TFLOP/s / GB/s)"
+    )
+    ap.add_argument("trace_dir")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="steps captured in the profile window")
+    ap.add_argument("--device", default="TPU",
+                    help="device process substring (default TPU)")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    out = summarize_trace(
+        args.trace_dir, steps=args.steps, device_substr=args.device,
+        top_n=args.top,
+    )
+    print(json.dumps(out) if args.json else format_summary(out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
