@@ -79,11 +79,14 @@ def main():
                         help="compute dtype (bf16 for real-scale runs; "
                         "f32 default keeps the tiny-model CI exact)")
     parser.add_argument("--attn", type=str, default="reference",
-                        choices=["reference", "fused", "flash"],
+                        choices=["reference", "fused", "flash", "ring",
+                                 "ulysses"],
                         help="attention implementation: 'fused'/'flash' "
                         "use the Pallas kernels (flash streams any length "
                         "with in-kernel dropout — the seq-2048 configs[4] "
-                        "path)")
+                        "path); 'ring'/'ulysses' add sequence parallelism "
+                        "over the sp mesh axis (both flash-bodied on TPU; "
+                        "on one chip they degenerate to flash/reference)")
     parser.add_argument("--remat", action="store_true",
                         help="per-layer rematerialization (trade FLOPs "
                         "for HBM — how billion-param seq-2048 fits one "
