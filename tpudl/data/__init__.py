@@ -7,6 +7,11 @@ from tpudl.data.converter import (  # noqa: F401
     prefetch_to_device,
     write_parquet,
 )
+from tpudl.data.ingest import (  # noqa: F401
+    ingest_cifar10,
+    ingest_image_folder,
+    ingest_sst2_tsv,
+)
 from tpudl.data.datasets import (  # noqa: F401
     materialize_cifar10_like,
     materialize_imagenet_like,

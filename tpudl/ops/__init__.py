@@ -15,6 +15,12 @@ from tpudl.ops.attention import (  # noqa: F401
     dot_product_attention,
     padding_mask,
 )
+from tpudl.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_with_lse,
+)
+from tpudl.ops.ring_attention import ring_attention  # noqa: F401
+from tpudl.ops.ulysses import ulysses_attention  # noqa: F401
 from tpudl.ops.moe import (  # noqa: F401
     EP_MOE_RULES,
     MoEMlp,

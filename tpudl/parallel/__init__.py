@@ -1,8 +1,13 @@
-"""Parallelism: sharding rules, activation constraints, pipeline schedule."""
+"""Parallelism: sharding rules, activation constraints, pipeline
+schedules (GPipe / 1F1B / interleaved virtual stages)."""
 
 from tpudl.parallel.pipeline import (  # noqa: F401
     PIPELINE_RULES,
+    interleave_stage_order,
     pipeline,
+    pipeline_1f1b,
+    pipeline_interleaved,
+    schedule_stats,
     stack_layer_params,
     stack_pytrees,
     stage_param_spec,

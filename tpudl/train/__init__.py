@@ -11,5 +11,10 @@ from tpudl.train.loop import (  # noqa: F401
     fit,
     make_classification_eval_step,
     make_classification_train_step,
+    pad_batch,
     resume_latest,
+)
+from tpudl.train.profiling import (  # noqa: F401
+    format_summary,
+    summarize_trace,
 )
