@@ -229,6 +229,39 @@ def main() -> int:
     uerr = float(jnp.mean(jnp.abs(uacc / un - ubase)))
     check(f"ulysses flash E[dropout out] ~ base (mean_abs {uerr:.4f})",
           uerr < 0.05)
+
+    # ring attention with the FLASH tick body (round 5): per-tick
+    # (o, lse) merge with in-kernel dropout whose lse is of the
+    # UNDROPPED distribution — deterministic per key, expectation
+    # matching the undropped output, on a real sp mesh shape (sp=1 on
+    # one chip exercises the shard_map + kernel path end to end).
+    from tpudl.ops.ring_attention import ring_attention
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+
+    # Wildcard dp: the mesh fits any device count (the script's
+    # run-anywhere contract); sp stays 1 so the ring body is the
+    # single-shard degenerate that still runs shard_map + the kernel.
+    rmesh = make_mesh(MeshSpec(dp=-1, sp=1))
+    r1 = ring_attention(qs2, ks2, vs2, causal=True, mesh=rmesh,
+                        local_impl="flash", dropout_rate=0.2,
+                        dropout_rng=frng)
+    r2 = ring_attention(qs2, ks2, vs2, causal=True, mesh=rmesh,
+                        local_impl="flash", dropout_rate=0.2,
+                        dropout_rng=frng)
+    check("ring flash dropout deterministic per key",
+          bool(jnp.all(r1 == r2)))
+    rbase = ring_attention(qs2, ks2, vs2, causal=True, mesh=rmesh,
+                           local_impl="flash")
+    rf = jax.jit(lambda r: ring_attention(
+        qs2, ks2, vs2, causal=True, mesh=rmesh, local_impl="flash",
+        dropout_rate=0.2, dropout_rng=r,
+    ))
+    racc = jnp.zeros_like(rbase)
+    for i in range(un):
+        racc = racc + rf(jax.random.key(400 + i))
+    rerr = float(jnp.mean(jnp.abs(racc / un - rbase)))
+    check(f"ring flash E[dropout out] ~ base (mean_abs {rerr:.4f})",
+          rerr < 0.05)
     return 1 if failures else 0
 
 
