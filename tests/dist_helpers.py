@@ -82,6 +82,26 @@ def failing_worker():
     raise RuntimeError("intentional worker failure")
 
 
+def record_obs_spans():
+    """Record deterministic per-rank step spans into the worker's obs
+    recorder (tpudl.runtime._worker enabled it from the TPUDL_OBS_DIR
+    the distributor injected): rank 1's steps are 10x slower — the
+    straggler the parent's merged report must attribute."""
+    import os
+
+    from tpudl.obs import spans as obs_spans
+
+    rec = obs_spans.active_recorder()
+    assert rec is not None, "worker obs recorder not enabled"
+    rank = int(os.environ.get("TPUDL_PROCESS_ID", "0"))
+    dur = 0.010 * (1 + 9 * rank)
+    for i in range(5):
+        rec.record(
+            "train_step", obs_spans.CAT_STEP, float(i), dur, {"step": i}
+        )
+    return rank
+
+
 def converter_fed_train(data_dir, local_batch=16):
     """The Petastorm-contract promise, actually executed multi-process
     (round-2 missing #4): each worker reads ITS disjoint converter shard

@@ -114,6 +114,22 @@ def test_generate_validates(model_and_params):
         )
 
 
+def test_validate_left_padded_rejects_nonbinary_mask():
+    """Regression (ADVICE round 5): a monotone mask with a non-binary
+    value (e.g. 2) passed validation but corrupts position = sum(mask)
+    and cache validity — the fused host check must reject it."""
+    from tpudl.models.generate import validate_left_padded
+
+    ok = jnp.asarray([[0, 0, 1, 1], [0, 1, 1, 1]], jnp.int32)
+    validate_left_padded(ok)  # binary left-padded: accepted
+    bad = jnp.asarray([[0, 0, 1, 2], [0, 1, 1, 1]], jnp.int32)
+    with pytest.raises(ValueError, match="binary"):
+        validate_left_padded(bad)
+    # Float masks with fractional values are equally corrupt.
+    with pytest.raises(ValueError, match="binary"):
+        validate_left_padded(jnp.asarray([[0.0, 0.5, 1.0, 1.0]]))
+
+
 def _left_pad(prompt, total_len, pad_id=0):
     """[B, L] -> ([B, total_len] left-padded ids, mask)."""
     b, length = prompt.shape

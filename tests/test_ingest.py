@@ -238,6 +238,82 @@ def test_ingest_image_folder_resize_headroom(tmp_path):
                             image_size=32, resize_shorter=16)
 
 
+def test_ingest_image_folder_atomic_publish(tmp_path):
+    """Regression (ADVICE round 5): an interrupted ingest must not leave
+    valid-looking part files in out_dir (label-mapped but class-name-less,
+    and a duplicate hazard for re-runs). Parts stage in
+    <out_dir>.ingest-tmp and publish only on completion; a re-run after
+    failure succeeds with no stale staging dir and no duplicate parts."""
+    from tpudl.data.ingest import ingest_image_folder
+
+    sizes = {"ants": [(40, 40), (40, 40)], "bees": [(40, 40)]}
+    root, _ = _write_image_tree(tmp_path, sizes, "PNG")
+    out = tmp_path / "out"
+    stage = tmp_path / "out.ingest-tmp"
+
+    # Corrupt the LAST file (sorted order: bees/) so the first chunk is
+    # already written when the decode fails — the partial-ingest shape.
+    bad = root / "bees" / "img_000.png"
+    good_bytes = bad.read_bytes()
+    bad.write_bytes(b"not an image")
+    with pytest.raises(Exception):
+        ingest_image_folder(str(root), str(out), image_size=32,
+                            rows_per_file=1)
+    # Nothing published: no out_dir at all, only the staging dir.
+    assert not out.exists()
+    assert stage.is_dir()
+
+    # Re-run after repair: stale staging is wiped, publish is complete,
+    # and the part count is exactly the chunk count (no duplicates).
+    bad.write_bytes(good_bytes)
+    conv = ingest_image_folder(str(root), str(out), image_size=32,
+                               rows_per_file=1)
+    assert conv.num_rows == 3
+    assert not stage.exists()
+    parts = sorted(p.name for p in out.glob("part-*.parquet"))
+    assert parts == ["part-00000.parquet", "part-00001.parquet",
+                     "part-00002.parquet"]
+    assert (out / "classes.txt").read_text().split() == ["ants", "bees"]
+
+    # A re-ingest over an EXISTING complete out_dir replaces it
+    # wholesale (directory swap, atomic at every kill point) — fewer
+    # chunks must not leave stale high-numbered parts, and unrelated
+    # user files in out_dir survive the swap.
+    stray = out / "notes.md"
+    stray.write_text("keep me")
+    conv = ingest_image_folder(str(root), str(out), image_size=32,
+                               rows_per_file=4)
+    assert conv.num_rows == 3
+    assert sorted(p.name for p in out.glob("part-*.parquet")) == [
+        "part-00000.parquet"
+    ]
+    assert stray.read_text() == "keep me"
+    retired = tmp_path / "out.ingest-old"
+    assert not retired.exists()
+
+    # Kill between the two publish renames: out_dir gone, the old
+    # dataset lives only in .ingest-old. The next run must RESTORE it
+    # (never wipe it) before re-ingesting — stray user files included.
+    out.rename(retired)
+    assert not out.exists()
+    conv = ingest_image_folder(str(root), str(out), image_size=32,
+                               rows_per_file=4)
+    assert conv.num_rows == 3
+    assert stray.read_text() == "keep me"
+    assert not retired.exists()
+
+    # Kill after the swap but before carry-over: both dirs exist, the
+    # stray file still sits in .ingest-old. The next run rescues it.
+    retired.mkdir()
+    (retired / "notes2.md").write_text("rescue me")
+    (retired / "part-09999.parquet").write_text("superseded")
+    conv = ingest_image_folder(str(root), str(out), image_size=32,
+                               rows_per_file=4)
+    assert (out / "notes2.md").read_text() == "rescue me"
+    assert not (out / "part-09999.parquet").exists()
+    assert not retired.exists()
+
+
 def test_ingest_image_folder_errors(tmp_path):
     from tpudl.data.ingest import ingest_image_folder
 

@@ -72,6 +72,25 @@ def test_stdlog_only_no_dir(caplog):
     assert "step=3" in caplog.text and "loss=0.125" in caplog.text
 
 
+def test_obs_fan_in_tolerates_reserved_metric_names(tmp_path):
+    """Metrics ride the obs stream NESTED: a metric literally named
+    'step' or 'ts' must neither crash the log call nor corrupt the
+    event record's reserved fields."""
+    import tpudl.obs as obs
+    from tpudl.obs import counters as obs_counters
+
+    rec = obs.enable(str(tmp_path))
+    try:
+        ml = MetricLogger(log_dir=None, stdlog=False)
+        ml.log(7, {"step": 5.0, "ts": 2.0, "loss": 0.1})
+        ev = [r for r in rec.records if r.get("kind") == "event"][0]
+        assert ev["step"] == 7  # the fit-step index, not the metric
+        assert ev["metrics"] == {"step": 5.0, "ts": 2.0, "loss": 0.1}
+    finally:
+        obs.disable()
+        obs_counters.registry().reset()
+
+
 def test_as_fit_logger_callback(tmp_path, tiny_cv_step):
     """MetricLogger plugs straight into fit(logger=...)."""
     from tpudl.data.synthetic import synthetic_classification_batches

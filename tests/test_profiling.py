@@ -69,6 +69,35 @@ def test_summarize_synthetic_trace(tmp_path):
     assert "convolution fusion" in txt and "fusion.1" in txt
 
 
+def test_op_stream_prefers_hlo_category_tid(tmp_path):
+    """Regression (ADVICE round 5): a launch/annotation thread with MORE
+    events than the HLO-op thread must not be selected as the op stream
+    — tids whose events carry args.hlo_category win; most-events is only
+    the fallback when no thread carries the field."""
+    launch = {
+        "ph": "X", "pid": 3, "tid": 1, "ts": 0.0, "dur": 10.0,
+        "name": "launch", "args": {},
+    }
+    events = [
+        _meta(3, "/device:TPU:0"),
+        # op stream (tid 3): only 2 events, but they carry hlo_category
+        _op(3, 3, "fusion.1", 1000.0, "convolution fusion", flops=1e9),
+        _op(3, 3, "fusion.2", 500.0, "loop fusion"),
+    ] + [dict(launch, ts=float(i)) for i in range(10)]  # noisier tid 1
+    root = _write_trace(tmp_path, events)
+    s = summarize_trace(root)
+    assert s["num_events"] == 2
+    np.testing.assert_allclose(s["total_ms_per_step"], 1.5)
+    assert set(s["by_category"]) == {"convolution fusion", "loop fusion"}
+
+    # Fallback: no thread carries hlo_category -> most-events wins.
+    bare = [_meta(3, "/device:TPU:0")] + [
+        dict(launch, ts=float(i)) for i in range(3)
+    ]
+    s2 = summarize_trace(_write_trace(tmp_path / "bare", bare))
+    assert s2["num_events"] == 3
+
+
 def test_fit_profile_hook_roundtrip(tmp_path):
     """fit(profile_dir=...) -> summarize_trace on the CPU backend: the
     whole capture-to-analysis loop works without TensorBoard."""

@@ -102,6 +102,59 @@ def test_evaluate_weighted_mean():
         evaluate(eval_step, state, batches, num_steps=0)
 
 
+def test_compile_step_warns_per_distinct_rebuilt_tx():
+    """Regression (ADVICE round 5): the graft warning fires for EVERY
+    distinct rebuilt tx — a second rebuilt state with (possibly
+    different) optimizer hyperparameters must not pass silently after
+    the first warning spent the once-per-wrapper budget."""
+    import warnings
+
+    import optax
+
+    from tpudl.data.synthetic import synthetic_classification_batches
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+
+    state = _make_state()
+    mesh = make_mesh(MeshSpec(dp=-1))
+    step = compile_step(
+        make_classification_train_step(), mesh, state, None,
+        donate_state=False,
+    )
+    batch = next(
+        synthetic_classification_batches(
+            16, image_shape=(16, 16, 3), num_classes=4
+        )
+    )
+    rng = jax.random.key(1)
+    state, _ = step(state, batch, rng)
+
+    def run(s):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            step(s, batch, rng)
+        return [x for x in w if "ORIGINALLY-COMPILED" in str(x.message)]
+
+    # First rebuilt tx warns; the SAME rebuilt state again does not
+    # (identical object, already flagged); a THIRD state with yet
+    # another tx warns again instead of passing silently.
+    rebuilt = state.replace(tx=optax.sgd(0.01, momentum=0.9))
+    assert len(run(rebuilt)) == 1
+    assert len(run(rebuilt)) == 0
+    rebuilt2 = state.replace(tx=optax.sgd(0.001, momentum=0.9))
+    assert len(run(rebuilt2)) == 1
+
+    # Bounded: a caller rebuilding tx EVERY call gets one suppression
+    # notice past the cap, then silence — not a warning (and a retained
+    # optimizer object) per step forever.
+    tail = [
+        run(state.replace(tx=optax.sgd(1e-4 * (k + 1), momentum=0.9)))
+        for k in range(10)
+    ]
+    flat = [str(w.message) for ws in tail for w in ws]
+    assert any("not be reported individually" in m for m in flat)
+    assert tail[-1] == [] and tail[-2] == []  # past the cap: silent
+
+
 def test_pad_batch():
     from tpudl.train.loop import pad_batch
 

@@ -21,6 +21,10 @@ A ground-up JAX/XLA/pjit/Pallas re-design of the capability surface of
   ICI replace the lineage's NCCL allreduce.
 - ``tpudl.train``    — Optax train loops, metrics (images/sec/chip, MFU),
   periodic async checkpointing with resume.
+- ``tpudl.obs``      — cross-layer runtime observability: host-side
+  span/counter recording through the loops, checkpointing, ingest, and
+  distributor workers; goodput accounting and the straggler report CLI
+  (``python -m tpudl.obs.report``). Stdlib-only, free when disabled.
 - ``tpudl.export``   — StableHLO export, cross-backend numerical parity and
   latency benchmarking — the reference's signature behavior
   (reference: notebooks/cv/onnx_experiments.py:81-144) rebuilt as a

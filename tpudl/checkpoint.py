@@ -21,8 +21,18 @@ import jax
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh
 
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import spans as obs_spans
 from tpudl.parallel.sharding import Rules, tree_shardings
 from tpudl.train.loop import TrainState
+
+
+def _ckpt_span(name: str, **attrs):
+    """Checkpoint-category obs span (no-op when observability is off).
+    Covers the SYNCHRONOUS part of a save — for async saves that is the
+    device->host copy, which is exactly the slice of wall-clock the
+    train loop loses to checkpointing."""
+    return obs_spans.span(name, obs_spans.CAT_CHECKPOINT, **attrs)
 
 
 def _state_payload(state: TrainState) -> dict:
@@ -60,8 +70,11 @@ def _abstract_payload(
 
 def save_train_state(path: str, state: TrainState, overwrite: bool = True) -> None:
     """One-shot full-train-state checkpoint at `path`."""
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), _state_payload(state), force=overwrite)
+    with _ckpt_span("save_train_state"):
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(
+                os.path.abspath(path), _state_payload(state), force=overwrite
+            )
 
 
 def restore_train_state(
@@ -73,10 +86,11 @@ def restore_train_state(
     """Restore a checkpoint into `state`'s structure (a freshly-initialized
     TrainState from the same model/optimizer code). With `mesh`/`rules`,
     leaves arrive sharded for that topology."""
-    with ocp.StandardCheckpointer() as ckptr:
-        payload = ckptr.restore(
-            os.path.abspath(path), _abstract_payload(state, mesh, rules)
-        )
+    with _ckpt_span("restore_train_state"):
+        with ocp.StandardCheckpointer() as ckptr:
+            payload = ckptr.restore(
+                os.path.abspath(path), _abstract_payload(state, mesh, rules)
+            )
     return state.replace(
         params=payload["params"],
         opt_state=payload["opt_state"],
@@ -124,9 +138,25 @@ class CheckpointManager:
         # save() and only backgrounds the disk write. If the checkpoint
         # backend ever changes to copy lazily, snapshot the payload here
         # (e.g. jax.device_get on single-host) before returning.
-        return self._mgr.save(
+        rec = obs_spans.active_recorder()
+        if rec is None:
+            return self._mgr.save(
+                step, args=ocp.args.StandardSave(_state_payload(state))
+            )
+        t0 = rec.clock()
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(_state_payload(state))
         )
+        dur = rec.clock() - t0
+        rec.record(
+            "checkpoint_save", obs_spans.CAT_CHECKPOINT, t0, dur,
+            {"step": step},
+        )
+        reg = obs_counters.registry()
+        reg.histogram("checkpoint_time_s").observe(dur)
+        if saved:
+            reg.counter("checkpoint_saves").inc()
+        return saved
 
     def restore(
         self,
@@ -141,10 +171,13 @@ class CheckpointManager:
                 raise FileNotFoundError(
                     f"no checkpoint found in {self._mgr.directory}"
                 )
-        payload = self._mgr.restore(
-            step,
-            args=ocp.args.StandardRestore(_abstract_payload(state, mesh, rules)),
-        )
+        with _ckpt_span("checkpoint_restore", step=step):
+            payload = self._mgr.restore(
+                step,
+                args=ocp.args.StandardRestore(
+                    _abstract_payload(state, mesh, rules)
+                ),
+            )
         return state.replace(
             params=payload["params"],
             opt_state=payload["opt_state"],

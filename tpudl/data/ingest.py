@@ -28,12 +28,69 @@ from __future__ import annotations
 
 import os
 import pickle
+import shutil
 import tarfile
-from typing import List
+from typing import Dict, List
 
 import numpy as np
 
 from tpudl.data.converter import make_converter, write_parquet
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import spans as obs_spans
+
+#: Obs span category for ingest chunks (outside the goodput step/compile
+#: taxonomy on purpose — ingest is a materialize-once cost, reported in
+#: the breakdown table's extra rows, not against training goodput).
+_INGEST_CAT = "ingest"
+
+
+def _carry_over_non_ingest(retired: str, out_dir: str) -> None:
+    """Move everything that is NOT ingest output (part files /
+    classes.txt) from a retired out_dir into the published one — user
+    files placed next to the dataset survive a re-ingest swap."""
+    for name in os.listdir(retired):
+        if name == "classes.txt" or (
+            name.startswith("part-") and name.endswith(".parquet")
+        ):
+            continue  # superseded ingest output, dropped with the dir
+        os.replace(
+            os.path.join(retired, name), os.path.join(out_dir, name)
+        )
+
+
+def _col_bytes(arr) -> int:
+    """Payload bytes of one column. dtype=object arrays (raw text)
+    count their encoded string payloads — ndarray.nbytes would count
+    8-byte pointers and underreport text ingest volume ~100x."""
+    a = np.asarray(arr)
+    if a.dtype == object:
+        return sum(len(str(x).encode("utf-8")) for x in a.ravel())
+    return int(a.nbytes)
+
+
+def _write_chunk(
+    directory: str,
+    columns: Dict[str, np.ndarray],
+    part: int,
+    **write_kwargs,
+) -> None:
+    """write_parquet one chunk with an obs span + byte/row counters
+    (no-op overhead when observability is off)."""
+    rec = obs_spans.active_recorder()
+    if rec is None:
+        write_parquet(directory, columns, part_offset=part, **write_kwargs)
+        return
+    nbytes = int(sum(_col_bytes(v) for v in columns.values()))
+    rows = len(next(iter(columns.values())))
+    t0 = rec.clock()
+    write_parquet(directory, columns, part_offset=part, **write_kwargs)
+    rec.record(
+        "ingest_chunk", _INGEST_CAT, t0, rec.clock() - t0,
+        {"part": part, "rows": rows, "bytes": nbytes},
+    )
+    reg = obs_counters.registry()
+    reg.counter("bytes_ingested").inc(nbytes)
+    reg.counter("rows_ingested").inc(rows)
 
 #: Member names inside the CIFAR-10 python archive, in canonical order.
 _CIFAR_TRAIN_BATCHES = tuple(f"data_batch_{i}" for i in range(1, 6))
@@ -122,11 +179,11 @@ def ingest_cifar10(
 
     part = 0
     for images, labels in batches:
-        write_parquet(
+        _write_chunk(
             out_dir,
             {"image": images, "label": labels},
+            part,
             rows_per_file=rows_per_file,
-            part_offset=part,
         )
         part += -(-len(labels) // rows_per_file)
     return make_converter(out_dir)
@@ -183,12 +240,13 @@ def ingest_sst2_tsv(
 
     if not sentences:
         raise ValueError(f"{path} contains no data rows")
-    write_parquet(
+    _write_chunk(
         out_dir,
         {
             "sentence": np.asarray(sentences, dtype=object),
             "label": np.asarray(labels, np.int64),
         },
+        0,
         rows_per_file=rows_per_file,
     )
     return make_converter(out_dir)
@@ -226,7 +284,16 @@ def ingest_image_folder(
     effective on 150 KB rows (same rationale as
     tpudl.data.datasets.materialize_imagenet_like). Everything
     downstream (augmenter crop/flip, uint8 wire + device_normalize) is
-    the existing configs[2] path:
+    the existing configs[2] path.
+
+    The ingest is ATOMIC at directory granularity: parts and classes.txt
+    stream into a ``<out_dir>.ingest-tmp`` staging directory and publish
+    to ``out_dir`` only on completion — a multi-hour ImageNet ingest
+    killed partway leaves no valid-looking part files that a converter
+    could open label-mapped-but-unnamed, and a re-run never mixes fresh
+    parts with a prior interrupted run's (stale staging dirs are wiped
+    on start; a complete prior ``out_dir`` is replaced wholesale).
+    Example:
 
         python notebooks/cv/train_cifar10.py --config imagenet_resnet50_dp \\
             --ingest /path/imagenet/train --data-dir /tmp/imagenet-parquet
@@ -276,21 +343,50 @@ def ingest_image_folder(
             im = im.crop((left, top, left + image_size, top + image_size))
             return np.asarray(im, np.uint8)
 
-    os.makedirs(out_dir, exist_ok=True)
+    out_dir = out_dir.rstrip("/\\") or out_dir
+    stage = out_dir + ".ingest-tmp"
+    retired = out_dir + ".ingest-old"
+    if os.path.isdir(stage):  # staging from an interrupted run: garbage
+        shutil.rmtree(stage)
+    if os.path.isdir(retired):
+        # A prior run died mid-swap. If out_dir is gone the old dataset
+        # lives ONLY here — restore it, never delete it; if out_dir
+        # exists the swap completed, so only rescue the unrelated user
+        # files the dead run didn't carry over.
+        if not os.path.isdir(out_dir):
+            os.rename(retired, out_dir)
+        else:
+            _carry_over_non_ingest(retired, out_dir)
+            shutil.rmtree(retired)
+    os.makedirs(stage)
     part = 0
     for start in range(0, len(files), rows_per_file):
         chunk = files[start : start + rows_per_file]
-        write_parquet(
-            out_dir,
+        _write_chunk(
+            stage,
             {
                 "image": np.stack([_decode(p) for p, _ in chunk]),
                 "label": np.asarray([i for _, i in chunk], np.int64),
             },
+            part,
             rows_per_file=rows_per_file,
             row_group_size=row_group_size,
-            part_offset=part,
         )
         part += 1
-    with open(os.path.join(out_dir, "classes.txt"), "w") as f:
+    with open(os.path.join(stage, "classes.txt"), "w") as f:
         f.write("\n".join(classes) + "\n")
+    # Publish by DIRECTORY RENAME only — never by per-file delete/move,
+    # which would open a window where out_dir holds a partial mix of old
+    # and new parts. Re-ingest over an existing out_dir swaps: the old
+    # dir is renamed aside (atomic), the stage renamed in (atomic), then
+    # any unrelated user files are carried over and the old dir deleted
+    # — a kill at any point leaves either the complete old or the
+    # complete new dataset, plus detectable .ingest-* leftovers that the
+    # next run wipes.
+    if os.path.isdir(out_dir):
+        os.rename(out_dir, retired)
+    os.rename(stage, out_dir)
+    if os.path.isdir(retired):
+        _carry_over_non_ingest(retired, out_dir)
+        shutil.rmtree(retired)
     return make_converter(out_dir)

@@ -147,19 +147,27 @@ def validate_sampling(temperature, top_k, top_p) -> None:
 def validate_left_padded(attention_mask) -> None:
     """Shared left-padded-mask contract for the live loop AND the
     exported serving loop (tpudl.export.decode — one definition, the
-    paths cannot diverge): every row must be 0s then 1s with at least
-    one real token. Right padding would leave the final slot — whose
-    logits seed generation — on a pad. One host sync."""
+    paths cannot diverge): every row must be BINARY 0s then 1s with at
+    least one real token. Right padding would leave the final slot —
+    whose logits seed generation — on a pad; a non-binary mask (e.g. a
+    2) would pass the monotonicity check yet corrupt
+    ``position = sum(mask)`` and with it cache validity. One host sync
+    for all three checks fused."""
+    m = attention_mask
     ok = jnp.logical_and(
-        jnp.all(attention_mask[:, 1:] >= attention_mask[:, :-1]),
-        jnp.all(jnp.sum(attention_mask, axis=-1) > 0),
+        jnp.logical_and(
+            jnp.all(m[:, 1:] >= m[:, :-1]),
+            jnp.all(jnp.sum(m, axis=-1) > 0),
+        ),
+        jnp.all((m == 0) | (m == 1)),
     )
     if not bool(ok):
         raise ValueError(
             "ragged prompt batches are served LEFT-padded: every "
-            "attention_mask row must be 0s then 1s with at least one "
-            "real token (right-padding would leave the final slot — "
-            "whose logits seed generation — on a pad)"
+            "attention_mask row must be binary (0/1) 0s then 1s with at "
+            "least one real token (right-padding would leave the final "
+            "slot — whose logits seed generation — on a pad; non-binary "
+            "values corrupt position = sum(mask))"
         )
 
 

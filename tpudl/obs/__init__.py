@@ -1,0 +1,55 @@
+"""L-cross runtime observability: spans, counters, goodput, reports.
+
+Three observability layers exist in tpudl, deliberately split:
+
+- ``tpudl.train.metrics``   — model-quality and throughput math
+  (images/sec/chip, MFU): numbers ABOUT the training computation.
+- ``tpudl.train.profiling`` — inside-the-step device view: parses the
+  XLA trace ``jax.profiler.trace`` writes into per-op-category time /
+  TFLOP/s / GB/s. Answers "where does the DEVICE step go".
+- ``tpudl.obs`` (this package) — outside-the-step host view: where the
+  rest of the RUN's wall-clock goes. Spans around the runtime's blocking
+  calls (data wait, compiled-step dispatch, compile, checkpoint save)
+  stream to JSONL; counters accumulate volumes (bytes ingested,
+  saves); the goodput classifier turns them into "this run was 71%
+  productive and host-3 was the straggler". Answers "where does the
+  WALL-CLOCK go" — the question neither of the other two can.
+
+The two trace views compose: ``SpanRecorder.export_chrome_trace``
+writes the host spans as Chrome trace-event JSON that loads in
+Perfetto NEXT TO the XLA device trace, one timeline.
+
+Zero hard dependencies (stdlib only), thread-safe, and free when
+disabled: every instrumentation site guards on
+``spans.active_recorder() is None``. Enable by setting
+``TPUDL_OBS_DIR=/path`` (the profiler-hook idiom) or calling
+``tpudl.obs.enable(path)``; report with
+``python -m tpudl.obs.report /path``.
+"""
+
+from tpudl.obs.counters import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    registry,
+)
+from tpudl.obs.goodput import (  # noqa: F401
+    classify,
+    classify_by_process,
+    format_goodput,
+)
+from tpudl.obs.report import (  # noqa: F401
+    build_report,
+    format_report,
+    load_records,
+)
+from tpudl.obs.spans import (  # noqa: F401
+    SpanRecorder,
+    active_recorder,
+    chrome_trace_events,
+    disable,
+    enable,
+    read_jsonl,
+    span,
+)

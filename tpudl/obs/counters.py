@@ -1,0 +1,179 @@
+"""Process-wide counters / gauges / histograms with snapshot export.
+
+The numeric complement of tpudl.obs.spans: spans say WHEN time went
+somewhere, counters say HOW MUCH of something accumulated (bytes
+ingested, checkpoint saves, worker retries) and histograms hold the
+per-step latency distributions (step_time, data_wait, compile_time,
+checkpoint_time) the report quotes p50/p95/p99 from.
+
+Stdlib-only and thread-safe like the span recorder. One module-level
+default registry; ``registry().snapshot()`` produces a plain-dict
+summary that rides the span JSONL stream as a ``{"kind": "counters"}``
+record (``SpanRecorder.counters``), so one file carries both."""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotonically increasing count (events, bytes, retries)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"Counter.inc is monotonic, got {n}")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (current lr, queue depth, loss)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+def percentile(sorted_values: List[float], q: float) -> float:
+    """Linear-interpolation percentile (numpy's default) on an already
+    SORTED list — stdlib-only so the obs layer carries no numpy
+    dependency."""
+    if not sorted_values:
+        return math.nan
+    if len(sorted_values) == 1:
+        return sorted_values[0]
+    pos = (len(sorted_values) - 1) * q
+    lo = int(math.floor(pos))
+    hi = int(math.ceil(pos))
+    if lo == hi:
+        return sorted_values[lo]
+    frac = pos - lo
+    return sorted_values[lo] * (1 - frac) + sorted_values[hi] * frac
+
+
+class Histogram:
+    """Latency/size distribution. Keeps raw observations (runs are
+    bounded — a 100k-step run is ~800 KB of floats), so snapshots report
+    exact percentiles rather than bucket estimates."""
+
+    __slots__ = ("_lock", "_values")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: List[float] = []
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._values.append(float(v))
+
+    @property
+    def count(self) -> int:
+        return len(self._values)
+
+    @property
+    def values(self) -> List[float]:
+        with self._lock:
+            return list(self._values)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            vals = sorted(self._values)
+        if not vals:
+            return {"count": 0}
+        return {
+            "count": len(vals),
+            "sum": sum(vals),
+            "min": vals[0],
+            "max": vals[-1],
+            "mean": sum(vals) / len(vals),
+            "p50": percentile(vals, 0.50),
+            "p95": percentile(vals, 0.95),
+            "p99": percentile(vals, 0.99),
+        }
+
+
+class Registry:
+    """Name -> instrument map with get-or-create accessors. A name is
+    bound to ONE kind; re-requesting it as another kind raises (two
+    subsystems silently sharing "step_time" as counter and histogram
+    would corrupt both)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls()
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"instrument {name!r} already registered as "
+                    f"{type(inst).__name__}, requested {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict:
+        """Plain-dict summary of every instrument, JSON-ready."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, inst in items:
+            if isinstance(inst, Counter):
+                out["counters"][name] = inst.value
+            elif isinstance(inst, Gauge):
+                out["gauges"][name] = inst.value
+            else:
+                out["histograms"][name] = inst.snapshot()
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_default: Optional[Registry] = None
+_default_lock = threading.Lock()
+
+
+def registry() -> Registry:
+    """The process-wide default registry (created on first use)."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = Registry()
+    return _default

@@ -1,0 +1,263 @@
+"""Aggregate span/counter streams into the run report: step-time
+breakdown, goodput, outliers, and per-host straggler attribution.
+
+    python -m tpudl.obs.report /path/to/obs-dir        # or *.jsonl files
+    python -m tpudl.obs.report run.jsonl --json
+    python -m tpudl.obs.report run.jsonl --chrome-trace trace.json
+
+This is the "why was this run only 71% productive, and which host was
+slow" answer as an artifact, not a vibe: it loads one or many span JSONL
+files (a distributor run merges its workers' files into the parent's —
+see tpudl.runtime.distributor — but loose per-worker files work too
+since every record carries host/process tags), then prints
+
+- a per-category latency table (count, total, mean, p50/p95/p99) over
+  data_wait / step / compile / checkpoint spans;
+- the goodput classification (tpudl.obs.goodput);
+- outlier steps (duration > ``outlier_factor`` x the p50 step time),
+  each attributed to its host/process;
+- per-host step-time means with stragglers flagged (mean above
+  ``straggler_factor`` x the cross-host median);
+- the last counters snapshot per process, if any rode the stream.
+
+``--chrome-trace`` additionally re-exports the loaded records as
+Chrome trace-event JSON for Perfetto, next to the XLA device trace."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+from tpudl.obs import goodput as goodput_mod
+from tpudl.obs.counters import percentile
+from tpudl.obs.spans import (
+    CAT_CHECKPOINT,
+    CAT_COMPILE,
+    CAT_DATA_WAIT,
+    CAT_EVAL,
+    CAT_STEP,
+    chrome_trace_events,
+    read_jsonl,
+)
+
+#: Table row order: the lifecycle order of one step.
+_TABLE_CATS = (CAT_DATA_WAIT, CAT_STEP, CAT_EVAL, CAT_COMPILE,
+               CAT_CHECKPOINT)
+
+
+def load_records(paths: Iterable[str]) -> List[dict]:
+    """Load span records from JSONL files and/or directories (directories
+    glob ``*.jsonl``, recursively — a distributor obs dir with a
+    ``workers/`` subdir loads in one argument)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            hits = sorted(
+                glob.glob(os.path.join(p, "**", "*.jsonl"), recursive=True)
+            )
+            if not hits:
+                raise FileNotFoundError(f"no *.jsonl files under {p}")
+            files.extend(hits)
+        else:
+            files.append(p)
+    records: List[dict] = []
+    for f in files:
+        records.extend(read_jsonl(f))
+    return records
+
+
+def _dist(durs: List[float]) -> dict:
+    vals = sorted(durs)
+    return {
+        "count": len(vals),
+        "total_s": sum(vals),
+        "mean_ms": 1e3 * sum(vals) / len(vals) if vals else 0.0,
+        "p50_ms": 1e3 * percentile(vals, 0.50) if vals else 0.0,
+        "p95_ms": 1e3 * percentile(vals, 0.95) if vals else 0.0,
+        "p99_ms": 1e3 * percentile(vals, 0.99) if vals else 0.0,
+    }
+
+
+def build_report(
+    records: List[dict],
+    outlier_factor: float = 3.0,
+    straggler_factor: float = 1.2,
+) -> dict:
+    """Span records -> report dict (see module docstring for contents)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    by_cat: Dict[str, List[float]] = {}
+    for s in spans:
+        by_cat.setdefault(s.get("cat", "other"), []).append(float(s["dur"]))
+    breakdown = {
+        cat: _dist(by_cat[cat]) for cat in _TABLE_CATS if cat in by_cat
+    }
+    for cat in sorted(set(by_cat) - set(_TABLE_CATS)):
+        breakdown[cat] = _dist(by_cat[cat])
+
+    # Outlier steps: anything beyond outlier_factor x the p50 TRAIN-step
+    # time (eval steps have their own duration scale and stay out of
+    # these statistics), attributed to host/process so cross-host blips
+    # are visible.
+    step_spans = [s for s in spans if s.get("cat") == CAT_STEP]
+    outliers: List[dict] = []
+    p50 = (
+        percentile(sorted(float(s["dur"]) for s in step_spans), 0.50)
+        if step_spans else 0.0
+    )
+    if p50 > 0:
+        for s in step_spans:
+            if float(s["dur"]) > outlier_factor * p50:
+                outliers.append({
+                    "host": s.get("host", "?"),
+                    "process": s.get("process", 0),
+                    "step": s.get("step"),
+                    "ms": 1e3 * float(s["dur"]),
+                    "x_p50": float(s["dur"]) / p50,
+                })
+        outliers.sort(key=lambda o: -o["ms"])
+
+    # Per-host/process straggler attribution over step-span means
+    # (grouped by recording process incl. OS pid — see
+    # goodput.process_key).
+    per_host_keyed: Dict[tuple, List[float]] = {}
+    for s in step_spans:
+        per_host_keyed.setdefault(
+            goodput_mod.process_key(s), []
+        ).append(float(s["dur"]))
+    labels = goodput_mod.process_labels(per_host_keyed)
+    per_host = {
+        labels[k]: per_host_keyed[k]
+        for k in sorted(per_host_keyed, key=lambda k: labels[k])
+    }
+    host_rows = {key: _dist(durs) for key, durs in per_host.items()}
+    means = sorted(r["mean_ms"] for r in host_rows.values())
+    median_mean = percentile(means, 0.50) if means else 0.0
+    for key, row in host_rows.items():
+        ratio = row["mean_ms"] / median_mean if median_mean > 0 else 0.0
+        row["x_median"] = ratio
+        row["straggler"] = bool(
+            len(host_rows) > 1 and ratio > straggler_factor
+        )
+
+    # Last counters snapshot per recording process, if any rode the
+    # stream.
+    counters_keyed: Dict[tuple, dict] = {}
+    for r in records:
+        if r.get("kind") == "counters":
+            counters_keyed[goodput_mod.process_key(r)] = r.get("data", {})
+    clabels = goodput_mod.process_labels(counters_keyed)
+    counters = {
+        clabels[k]: counters_keyed[k]
+        for k in sorted(counters_keyed, key=lambda k: clabels[k])
+    }
+
+    return {
+        "num_records": len(records),
+        "num_span_records": len(spans),
+        "breakdown": breakdown,
+        "goodput": goodput_mod.classify_by_process(records),
+        "outlier_steps": outliers,
+        "outlier_factor": outlier_factor,
+        "per_host": host_rows,
+        "straggler_factor": straggler_factor,
+        "counters": counters,
+    }
+
+
+def format_report(report: dict) -> str:
+    """Human-readable rendering of a ``build_report`` result."""
+    lines = [
+        f"tpudl obs report — {report['num_span_records']} spans, "
+        f"{len(report['per_host']) or 1} process(es)",
+        "",
+        f"{'category':14} {'count':>6} {'total_s':>8} {'mean_ms':>9} "
+        f"{'p50_ms':>9} {'p95_ms':>9} {'p99_ms':>9}",
+    ]
+    for cat, r in report["breakdown"].items():
+        lines.append(
+            f"{cat:14} {r['count']:6d} {r['total_s']:8.2f} "
+            f"{r['mean_ms']:9.2f} {r['p50_ms']:9.2f} {r['p95_ms']:9.2f} "
+            f"{r['p99_ms']:9.2f}"
+        )
+
+    gp = report["goodput"]
+    lines += ["", goodput_mod.format_goodput(gp["overall"])]
+    if len(gp["per_process"]) > 1:
+        for key, cls in gp["per_process"].items():
+            lines.append(f"  {key:20} {goodput_mod.format_goodput(cls)}")
+
+    if report["per_host"]:
+        lines += [
+            "",
+            f"{'host/process':20} {'steps':>6} {'mean_ms':>9} "
+            f"{'p95_ms':>9} {'x_median':>9}",
+        ]
+        for key, r in report["per_host"].items():
+            flag = "  STRAGGLER" if r["straggler"] else ""
+            lines.append(
+                f"{key:20} {r['count']:6d} {r['mean_ms']:9.2f} "
+                f"{r['p95_ms']:9.2f} {r['x_median']:9.2f}{flag}"
+            )
+
+    if report["outlier_steps"]:
+        lines += [
+            "",
+            f"outlier steps (> {report['outlier_factor']:g}x p50): "
+            f"{len(report['outlier_steps'])}",
+        ]
+        for o in report["outlier_steps"][:10]:
+            step = f" step {o['step']}" if o["step"] is not None else ""
+            lines.append(
+                f"  {o['ms']:9.2f} ms ({o['x_p50']:.1f}x p50) "
+                f"{o['host']}/p{o['process']}{step}"
+            )
+
+    for key, snap in report["counters"].items():
+        cs = snap.get("counters", {})
+        if cs:
+            rendered = " ".join(f"{k}={v:g}" for k, v in sorted(cs.items()))
+            lines.append(f"counters {key}: {rendered}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Aggregate tpudl obs span files into a step-time "
+        "breakdown, goodput fraction, and straggler attribution"
+    )
+    ap.add_argument(
+        "paths", nargs="+",
+        help="span *.jsonl files and/or obs directories",
+    )
+    ap.add_argument("--outlier-factor", type=float, default=3.0,
+                    help="flag steps slower than this multiple of p50")
+    ap.add_argument("--straggler-factor", type=float, default=1.2,
+                    help="flag hosts with mean step time above this "
+                    "multiple of the cross-host median")
+    ap.add_argument("--chrome-trace", metavar="OUT.json",
+                    help="also export the records as Chrome trace-event "
+                    "JSON for Perfetto")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.paths)
+    report = build_report(
+        records,
+        outlier_factor=args.outlier_factor,
+        straggler_factor=args.straggler_factor,
+    )
+    if args.chrome_trace:
+        with open(args.chrome_trace, "w") as f:
+            json.dump({"traceEvents": chrome_trace_events(records)}, f)
+    print(json.dumps(report) if args.json else format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

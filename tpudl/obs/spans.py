@@ -1,0 +1,382 @@
+"""Host-side span/event recorder: the wall-clock half of observability.
+
+`tpudl.train.profiling` answers "where does the DEVICE step go" from the
+XLA trace; this module answers "where does the rest of the RUN go" —
+data stalls, compile, checkpointing, idle — by recording host-side spans
+around the runtime's blocking calls. Records are plain dicts with a
+monotonic timestamp, duration, category, and host/process tags, exported
+two ways:
+
+- **JSONL** (one record per line, streamed as recorded) — the greppable
+  artifact ``python -m tpudl.obs.report`` aggregates into goodput and
+  straggler tables;
+- **Chrome trace-event JSON** (``export_chrome_trace``) — loads in
+  Perfetto/chrome://tracing NEXT TO the XLA device trace
+  ``jax.profiler.trace`` writes, so host spans and device ops line up in
+  one timeline view.
+
+Design constraints, all load-bearing:
+
+- **zero hard dependencies** — stdlib only, importable everywhere
+  (data workers, checkpoint path, spawned distributor ranks);
+- **thread-safe** — async checkpoint flushes and data prefetch threads
+  record concurrently with the train loop;
+- **injectable clock** — tests pass a fake monotonic clock and get
+  byte-deterministic exports;
+- **disabled is free** — ``active_recorder()`` returns None unless
+  ``enable()`` was called or TPUDL_OBS_DIR is set; instrumentation
+  sites guard on that None, so a disabled run adds one env lookup per
+  fit() call and nothing per step.
+
+Activation mirrors the profiler hook: set ``TPUDL_OBS_DIR=/path`` (or
+call ``enable(path)``) and every instrumented layer streams into
+``spans-<host>-p<process>-<pid>.jsonl`` under it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+#: Span categories the goodput classifier understands (see
+#: tpudl.obs.goodput). Instrumentation may invent others; they land in
+#: the report's "other" bucket.
+CAT_STEP = "step"
+CAT_EVAL = "eval"
+CAT_COMPILE = "compile"
+CAT_DATA_WAIT = "data_wait"
+CAT_CHECKPOINT = "checkpoint"
+#: Enclosing lifetime spans (a distributor worker's whole run): they
+#: OVERLAP the categorized spans inside them, so the goodput classifier
+#: uses them only to extend the run window, never as accounted time.
+CAT_ENCLOSING = "worker"
+
+
+class _Span:
+    """Context manager recording one span on exit. Created by
+    ``SpanRecorder.span`` — never when recording is disabled (the
+    module-level ``span()`` returns a shared no-op instead)."""
+
+    __slots__ = ("_rec", "_name", "_cat", "_attrs", "_t0")
+
+    def __init__(self, rec: "SpanRecorder", name: str, cat: str, attrs: dict):
+        self._rec = rec
+        self._name = name
+        self._cat = cat
+        self._attrs = attrs
+
+    def __enter__(self) -> "_Span":
+        self._t0 = self._rec.clock()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._rec.record(
+            self._name, self._cat, self._t0,
+            self._rec.clock() - self._t0, self._attrs,
+        )
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path (one module
+    singleton — entering it allocates nothing)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class SpanRecorder:
+    """Thread-safe span/event sink with streaming JSONL and in-memory
+    record lists.
+
+    Every record is a flat dict:
+
+    - spans:    ``{"kind": "span", "name", "cat", "ts", "dur", "host",
+      "process", "pid", "tid", ...attrs}``
+    - events:   ``{"kind": "event", "name", "cat", "ts", ...tags}``
+    - counters: ``{"kind": "counters", "ts", "data": {...}}`` (a
+      tpudl.obs.counters snapshot riding the same stream)
+
+    ``ts``/``dur`` are seconds on the injected monotonic ``clock``
+    (default ``time.monotonic`` — comparable within one process, not
+    across hosts; the report aggregates durations, never cross-host
+    timestamps).
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        clock: Callable[[], float] = time.monotonic,
+        host: Optional[str] = None,
+        process: Optional[int] = None,
+    ):
+        self.clock = clock
+        self.path = path
+        self.host = host if host is not None else socket.gethostname()
+        self.process = (
+            process
+            if process is not None
+            else int(os.environ.get("TPUDL_PROCESS_ID", "0"))
+        )
+        self._lock = threading.Lock()
+        self._records: list = []
+        self._file = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._file = open(path, "a")
+
+    # -- recording -----------------------------------------------------
+
+    def span(self, name: str, cat: str = CAT_STEP, **attrs) -> _Span:
+        """Context manager: ``with rec.span("save", "checkpoint"): ...``"""
+        return _Span(self, name, cat, attrs)
+
+    def record(
+        self, name: str, cat: str, ts: float, dur: float,
+        attrs: Optional[dict] = None,
+    ) -> dict:
+        """Append one completed span (the explicit form the hot loops use
+        so the disabled branch stays allocation-free)."""
+        rec = {
+            "kind": "span", "name": name, "cat": cat,
+            "ts": ts, "dur": dur,
+            "host": self.host, "process": self.process,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+        }
+        if attrs:
+            rec.update(attrs)
+        self._emit(rec)
+        return rec
+
+    def event(self, name: str, cat: str = "event", **tags) -> dict:
+        """Instant (zero-duration) event — e.g. a per-step metrics blob.
+        ``tags`` must not use the reserved record keys (kind/name/cat/
+        ts/host/process/pid); nest free-form payloads under one tag
+        (see MetricLogger's ``metrics=``)."""
+        rec = {
+            "kind": "event", "name": name, "cat": cat, "ts": self.clock(),
+            "host": self.host, "process": self.process, "pid": os.getpid(),
+        }
+        reserved = set(rec) & set(tags)
+        if reserved:
+            raise ValueError(
+                f"event tags collide with reserved record keys: "
+                f"{sorted(reserved)} — nest them under one tag instead"
+            )
+        rec.update(tags)
+        self._emit(rec)
+        return rec
+
+    def counters(self, snapshot: dict) -> dict:
+        """Attach a tpudl.obs.counters snapshot to the stream."""
+        rec = {
+            "kind": "counters", "ts": self.clock(),
+            "host": self.host, "process": self.process, "pid": os.getpid(),
+            "data": snapshot,
+        }
+        self._emit(rec)
+        return rec
+
+    def ingest(self, record: dict) -> None:
+        """Append an already-built record verbatim (the distributor's
+        merge path: worker records keep THEIR host/process tags)."""
+        self._emit(record)
+
+    def _emit(self, rec: dict) -> None:
+        # Streamed OR buffered, never both: a file-backed recorder keeps
+        # nothing in memory (a million-step run must not grow the host
+        # RSS by its own telemetry); `records` re-reads the file.
+        with self._lock:
+            if self._file is not None:
+                self._file.write(json.dumps(rec) + "\n")
+                self._file.flush()
+            else:
+                self._records.append(rec)
+
+    # -- export --------------------------------------------------------
+
+    @property
+    def records(self) -> list:
+        with self._lock:
+            if self.path is not None:
+                if not os.path.exists(self.path):
+                    return []
+                return read_jsonl(self.path)
+            return list(self._records)
+
+    def export_jsonl(self, path: str) -> str:
+        """Write the in-memory records to ``path`` (one JSON per line)."""
+        with open(path, "w") as f:
+            for rec in self.records:
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+    def export_chrome_trace(self, path: str) -> str:
+        """Write records as Chrome trace-event JSON (see module docstring)."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": chrome_trace_events(self.records)}, f)
+        return path
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self) -> "SpanRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def chrome_trace_events(records: Iterable[dict]) -> list:
+    """tpudl span/event records -> Chrome trace-event list.
+
+    Spans become complete ("X") events, instants become "i" events; each
+    recording process — keyed (host, process-index, OS pid), since a
+    distributor parent and its rank-0 worker share the first two but
+    have unrelated monotonic clocks — gets its own trace pid with a
+    process_name metadata row, so a merged multi-host file renders one
+    lane per worker next to the XLA device lanes."""
+    out = []
+    proc_ids: dict = {}
+    seen_labels: dict = {}
+    for rec in records:
+        key = (rec.get("host", "?"), rec.get("process", 0), rec.get("pid"))
+        if key not in proc_ids:
+            proc_ids[key] = len(proc_ids) + 1
+            label = f"tpudl host:{key[0]} p{key[1]}"
+            if seen_labels.setdefault(label, key) != key:
+                label = f"{label} pid{key[2]}"
+            out.append({
+                "ph": "M", "pid": proc_ids[key], "name": "process_name",
+                "args": {"name": label},
+            })
+        pid = proc_ids[key]
+        tid = rec.get("tid", 0)
+        if rec.get("kind") == "span":
+            args = {
+                k: v for k, v in rec.items()
+                if k not in ("kind", "name", "cat", "ts", "dur",
+                             "host", "process", "pid", "tid")
+            }
+            out.append({
+                "ph": "X", "name": rec["name"], "cat": rec["cat"],
+                "ts": rec["ts"] * 1e6, "dur": rec["dur"] * 1e6,
+                "pid": pid, "tid": tid, "args": args,
+            })
+        elif rec.get("kind") == "event":
+            out.append({
+                "ph": "i", "s": "t", "name": rec["name"],
+                "cat": rec.get("cat", "event"), "ts": rec["ts"] * 1e6,
+                "pid": pid, "tid": tid,
+            })
+    return out
+
+
+def read_jsonl(path: str) -> list:
+    """Load one span JSONL file back into record dicts.
+
+    A TORN FINAL LINE is skipped, not raised: span files are written
+    append-only by live processes, so a worker SIGKILLed mid-flush
+    legitimately leaves a partial last record — and the distributor's
+    merge runs exactly when workers died, where a JSONDecodeError would
+    mask the real failure. Corruption anywhere else still raises."""
+    records = []
+    with open(path) as f:
+        lines = [ln.strip() for ln in f]
+    lines = [ln for ln in lines if ln]
+    for idx, line in enumerate(lines):
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if idx == len(lines) - 1:
+                break  # torn tail of a killed writer
+            raise
+    return records
+
+
+# ---------------------------------------------------------------------------
+# Module-level active recorder (the switch every instrumentation site
+# consults).
+# ---------------------------------------------------------------------------
+
+_active: Optional[SpanRecorder] = None
+_atexit_registered = False
+
+
+def default_span_path(directory: str) -> str:
+    """Per-(host, process-index, os-pid) span file under ``directory`` —
+    collision-free when a distributor parent and its rank-0 worker share
+    the directory."""
+    host = socket.gethostname()
+    proc = int(os.environ.get("TPUDL_PROCESS_ID", "0"))
+    return os.path.join(
+        directory, f"spans-{host}-p{proc}-{os.getpid()}.jsonl"
+    )
+
+
+def enable(
+    path: str,
+    clock: Callable[[], float] = time.monotonic,
+    process: Optional[int] = None,
+) -> SpanRecorder:
+    """Activate recording. ``path`` is a directory (a per-process
+    ``spans-*.jsonl`` is created inside) or an explicit ``*.jsonl``
+    file. Idempotent per path; re-enabling replaces the active
+    recorder."""
+    global _active, _atexit_registered
+    if _active is not None:
+        _active.close()
+    file_path = (
+        path if path.endswith(".jsonl") else default_span_path(path)
+    )
+    _active = SpanRecorder(file_path, clock=clock, process=process)
+    if not _atexit_registered:
+        atexit.register(disable)
+        _atexit_registered = True
+    return _active
+
+
+def disable() -> None:
+    """Deactivate and flush the active recorder (no-op when inactive)."""
+    global _active
+    if _active is not None:
+        _active.close()
+        _active = None
+
+
+def active_recorder() -> Optional[SpanRecorder]:
+    """The active recorder, auto-enabling from TPUDL_OBS_DIR on first
+    call (mirrors fit()'s TPUDL_PROFILE_DIR idiom) — None when disabled,
+    which is the branch every hot path takes for free."""
+    if _active is not None:
+        return _active
+    obs_dir = os.environ.get("TPUDL_OBS_DIR")
+    if obs_dir:
+        return enable(obs_dir)
+    return None
+
+
+def span(name: str, cat: str = CAT_STEP, **attrs):
+    """Module-level convenience: a recording context manager when
+    observability is on, a shared no-op otherwise. Cold paths use this
+    (ingest chunks, checkpoint saves); per-step loops use the explicit
+    ``active_recorder()``/``record()`` form instead."""
+    rec = active_recorder()
+    if rec is None:
+        return _NULL_SPAN
+    return rec.span(name, cat, **attrs)

@@ -23,6 +23,19 @@ def main() -> int:
     jax.config.update("jax_platforms", platform)
     jax.distributed.initialize(coord, num_processes=nproc, process_id=pid)
 
+    # Observability: the distributor points TPUDL_OBS_DIR at its
+    # workers/ merge directory; enable eagerly (rather than waiting for
+    # fit()'s lazy activation) so every worker leaves a span file with a
+    # top-level worker_run span even when the payload touches no
+    # instrumented layer — per-rank wall-clock is what the straggler
+    # report attributes.
+    rec = None
+    if os.environ.get("TPUDL_OBS_DIR"):
+        from tpudl.obs import spans as obs_spans
+
+        rec = obs_spans.enable(os.environ["TPUDL_OBS_DIR"], process=pid)
+
+    t0 = rec.clock() if rec is not None else 0.0
     try:
         with open(payload_path, "rb") as f:
             fn, args, kwargs = pickle.load(f)
@@ -31,6 +44,11 @@ def main() -> int:
     except Exception:
         result = ("error", traceback.format_exc())
         code = 1
+    if rec is not None:
+        rec.record(
+            "worker_run", "worker", t0, rec.clock() - t0,
+            {"ok": code == 0, "platform": platform},
+        )
 
     tmp = result_path + ".tmp"
     with open(tmp, "wb") as f:

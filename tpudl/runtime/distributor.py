@@ -26,6 +26,7 @@ Three modes:
 from __future__ import annotations
 
 import dataclasses
+import glob
 import os
 import pickle
 import shutil
@@ -35,6 +36,8 @@ import sys
 import tempfile
 import time
 from typing import Any, Callable, List, Optional, Sequence
+
+from tpudl.obs import spans as obs_spans
 
 
 def _free_port() -> int:
@@ -118,6 +121,36 @@ class TpuDistributor:
             return [fn(*args, **kwargs)]
         return self._spawn_local(fn, args, kwargs)
 
+    # ------------------------------------------------------------------
+    # observability plumbing: each spawned worker streams its own span
+    # file (tagged host/process — tpudl.obs.spans picks the tags up from
+    # the TPUDL_* env this launcher already sets) into a workers/ subdir
+    # of the parent's obs directory; run() merges those records into the
+    # parent's stream afterward, so one `python -m tpudl.obs.report`
+    # over the parent file sees every rank and can attribute cross-host
+    # stragglers. Merged even when workers FAIL — that is precisely when
+    # the spans matter.
+    # ------------------------------------------------------------------
+
+    def _obs_workers_dir(self) -> Optional[str]:
+        rec = obs_spans.active_recorder()
+        if rec is None or not rec.path:
+            return None
+        return os.path.join(os.path.dirname(rec.path), "workers")
+
+    def _merge_worker_spans(self, workers_dir: str) -> None:
+        rec = obs_spans.active_recorder()
+        if rec is None:
+            return
+        for path in sorted(glob.glob(os.path.join(workers_dir, "*.jsonl"))):
+            for record in obs_spans.read_jsonl(path):
+                rec.ingest(record)
+            os.remove(path)  # merged: a dir-wide report must not double-count
+        try:
+            os.rmdir(workers_dir)
+        except OSError:
+            pass
+
     def _spawn_local(self, fn, args, kwargs) -> List[Any]:
         try:
             payload = pickle.dumps((fn, args, kwargs))
@@ -129,12 +162,21 @@ class TpuDistributor:
 
         coord = self.coordinator_address or f"localhost:{_free_port()}"
         workdir = tempfile.mkdtemp(prefix="tpudl_dist_")
+        obs_workers = self._obs_workers_dir()
         try:
-            return self._spawn_in(workdir, coord, payload)
+            return self._spawn_in(workdir, coord, payload, obs_workers)
         finally:
             shutil.rmtree(workdir, ignore_errors=True)
+            if obs_workers is not None:
+                self._merge_worker_spans(obs_workers)
 
-    def _spawn_in(self, workdir: str, coord: str, payload: bytes) -> List[Any]:
+    def _spawn_in(
+        self,
+        workdir: str,
+        coord: str,
+        payload: bytes,
+        obs_workers: Optional[str] = None,
+    ) -> List[Any]:
         payload_path = os.path.join(workdir, "payload.pkl")
         with open(payload_path, "wb") as f:
             f.write(payload)
@@ -149,6 +191,13 @@ class TpuDistributor:
             env["TPUDL_NUM_PROCESSES"] = str(self.num_processes)
             env["TPUDL_PROCESS_ID"] = str(pid)
             env["TPUDL_PLATFORM"] = self.platform
+            if obs_workers is not None:
+                env["TPUDL_OBS_DIR"] = obs_workers
+            else:
+                # Parent has no active recorder: workers must not
+                # auto-enable one from an inherited TPUDL_OBS_DIR and
+                # write files run() would never merge.
+                env.pop("TPUDL_OBS_DIR", None)
             if self.platform == "cpu":
                 flags = env.get("XLA_FLAGS", "")
                 flags = " ".join(

@@ -9,7 +9,12 @@ booleans to stdout; SURVEY.md §5.5). Here metrics flow through one
 - a JSONL file (one {"step": ..., metrics...} object per line — the
   greppable artifact for offline analysis);
 - TensorBoard scalars when the writer is importable (guarded — the
-  framework carries no hard TB dependency).
+  framework carries no hard TB dependency);
+- the tpudl.obs span stream, when observability is enabled: each log
+  call lands as a {"kind": "event", "name": "metrics"} record in the
+  run's span JSONL (so ONE artifact carries spans, counters, and
+  training metrics) and sets metric.<name> gauges in the counters
+  registry.
 
 `MetricLogger.__call__(step, metrics)` matches the `logger=` callback
 contract of tpudl.train.fit, so wiring is one argument.
@@ -21,6 +26,9 @@ import json
 import logging
 import os
 from typing import Dict, Optional
+
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import spans as obs_spans
 
 _log = logging.getLogger("tpudl.metrics")
 
@@ -63,6 +71,15 @@ class MetricLogger:
         if self._tb is not None:
             for k, v in scalars.items():
                 self._tb.add_scalar(k, v, step)
+        rec = obs_spans.active_recorder()
+        if rec is not None:
+            # Metrics ride NESTED under one tag: user metric names are
+            # arbitrary and must not collide with the record's reserved
+            # keys (a metric literally named "step" or "ts" would).
+            rec.event("metrics", cat="metrics", step=step, metrics=scalars)
+            reg = obs_counters.registry()
+            for k, v in scalars.items():
+                reg.gauge(f"metric.{k}").set(v)
 
     def close(self) -> None:
         if self._jsonl is not None:

@@ -21,6 +21,8 @@ import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import spans as obs_spans
 from tpudl.parallel.sharding import (
     Rules,
     active_mesh,
@@ -421,7 +423,15 @@ def compile_step(
         return jax.tree.unflatten(treedef, placed)
 
     state_treedef = jax.tree.structure(state)
-    warned_graft = []
+    # Distinct tx objects already warned about, keyed by id with the
+    # object held so ids can't be recycled by the allocator. Seeded with
+    # the compile-time tx: a rebuilt state that still carries the
+    # ORIGINAL tx (apply_fn-only rebuild) grafts silently. Bounded: a
+    # caller rebuilding its state EVERY call would otherwise grow this
+    # dict (and the warning stream) one entry per step — past the cap,
+    # one final suppression notice and no further tracking.
+    seen_txs = {id(state.tx): state.tx}
+    _TX_WARN_CAP = 8
 
     def wrapped(state_arg, batch, *rest):
         if jax.tree.structure(state_arg) != state_treedef:
@@ -431,36 +441,79 @@ def compile_step(
             # in_shardings prefix matching rejects. The executable
             # encodes the ORIGINAL tx, so grafting the incoming leaves
             # into the compile-time treedef is the correct semantics
-            # (leaf-count mismatches still raise here). Warn once: if
-            # the caller's rebuilt state genuinely carries DIFFERENT
-            # hyperparameters (a new lr, a different schedule), they
-            # are silently superseded by the compiled ones.
-            if not warned_graft:
-                warned_graft.append(True)
+            # (leaf-count mismatches still raise here). Warn once PER
+            # DISTINCT incoming tx — not once per wrapper — so a second
+            # rebuilt state whose tx genuinely carries different
+            # hyperparameters (a new lr, a different schedule) is
+            # flagged too, instead of passing silently after the first
+            # warning fired.
+            tx = getattr(state_arg, "tx", None)
+            if (
+                tx is not None
+                and id(tx) not in seen_txs
+                and len(seen_txs) <= _TX_WARN_CAP
+            ):
+                seen_txs[id(tx)] = tx
                 import warnings
 
-                warnings.warn(
-                    "compile_step: incoming state's pytree metadata "
-                    "(apply_fn/tx) differs from the compile-time state; "
-                    "its array leaves are grafted into the ORIGINAL "
-                    "treedef and the ORIGINAL compiled optimizer applies "
-                    "— rebuild the compiled step if you changed "
-                    "optimizer hyperparameters",
-                    stacklevel=2,
-                )
+                if len(seen_txs) > _TX_WARN_CAP:
+                    warnings.warn(
+                        "compile_step: more than "
+                        f"{_TX_WARN_CAP - 1} distinct rebuilt optimizers "
+                        "grafted into this compiled step — further ones "
+                        "will not be reported individually (the "
+                        "ORIGINALLY-COMPILED optimizer still applies to "
+                        "all of them)",
+                        stacklevel=2,
+                    )
+                else:
+                    warnings.warn(
+                        "compile_step: incoming state's pytree metadata "
+                        "(apply_fn/tx) differs from the compile-time "
+                        "state; its array leaves are grafted into the "
+                        "ORIGINAL treedef and the ORIGINALLY-COMPILED "
+                        "optimizer still applies — rebuild the compiled "
+                        "step if you changed optimizer hyperparameters",
+                        stacklevel=2,
+                    )
             state_arg = jax.tree.unflatten(
                 state_treedef, jax.tree.leaves(state_arg)
             )
         state_arg = _placed(state_arg, state_sh)
         batch = _placed(batch, batch_sh)
         with active_mesh(mesh):
-            return jitted(state_arg, batch, *rest)
+            out = jitted(state_arg, batch, *rest)
+        if wrapped._tpudl_compile_pending:
+            # First-call marker for the observability layer: fit() and
+            # evaluate() read it BEFORE each call to classify that
+            # call's wall-clock as "compile" (trace+compile dominates
+            # the first invocation) vs "step". Approximate on purpose —
+            # a later new-shape recompile (e.g. evaluate's padded
+            # variant) still counts as a step.
+            wrapped._tpudl_compile_pending = False
+        return out
 
     wrapped.jitted = jitted  # expose for lower()/cost analysis
     wrapped.state_shardings = state_sh
     wrapped.batch_sharding = batch_sh
     wrapped._tpudl_mask_aware = getattr(step_fn, "_tpudl_mask_aware", False)
+    wrapped._tpudl_compile_pending = True
     return wrapped
+
+
+def _obs_pull(rec, it, attrs):
+    """Timed ``next(it)`` recording a data_wait span — the instrumented
+    arm shared by fit() and evaluate() (their uninstrumented fast paths
+    stay inline so the disabled mode allocates nothing per step).
+    Returns ``(batch, wait_seconds)`` or ``None`` on exhaustion."""
+    t0 = rec.clock()
+    try:
+        batch = next(it)
+    except StopIteration:
+        return None
+    dur = rec.clock() - t0
+    rec.record("data_wait", obs_spans.CAT_DATA_WAIT, t0, dur, attrs)
+    return batch, dur
 
 
 def fit(
@@ -492,12 +545,32 @@ def fit(
     step counter, so a restored-and-continued run lines up with the
     schedule of an uninterrupted one. Use `resume_latest` to restore
     before calling fit.
+
+    Observability (tpudl.obs): with TPUDL_OBS_DIR set (or
+    tpudl.obs.enable called), every step records a data-wait span (time
+    blocked on the batch iterator) and a step span (time in the
+    compiled-step call — the FIRST call classifies as "compile" via
+    compile_step's first-call marker), and step/data-wait/compile
+    latency histograms accumulate in the counters registry, snapshotted
+    into the span stream at the end. Host-side accounting: under JAX
+    async dispatch the per-step span measures dispatch + backpressure
+    time, which converges to device step time in the steady state.
+    Disabled (the default) costs one env lookup per fit() call and
+    nothing per step.
     """
     import os
 
     profile_dir = profile_dir or os.environ.get("TPUDL_PROFILE_DIR")
     prof_start, prof_stop = profile_window
     profiling = False
+
+    rec = obs_spans.active_recorder()
+    if rec is not None:
+        reg = obs_counters.registry()
+        h_step = reg.histogram("step_time_s")
+        h_data = reg.histogram("data_wait_s")
+        h_compile = reg.histogram("compile_time_s")
+        clock = rec.clock
 
     metrics = None
     start = time.perf_counter()
@@ -508,14 +581,41 @@ def fit(
     start_step = (
         int(state.step) if checkpoint_manager is not None else 0
     )
+    it = iter(batches)
+    i = 0
     try:
-        for i, batch in enumerate(batches):
-            if num_steps is not None and i >= num_steps:
-                break
+        while num_steps is None or i < num_steps:
+            if rec is None:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+            else:
+                pulled = _obs_pull(rec, it, {"step": i})
+                if pulled is None:
+                    break
+                batch, wait = pulled
+                h_data.observe(wait)
             if profile_dir and i == prof_start:
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
-            state, metrics = compiled_step(state, batch, rng)
+            if rec is None:
+                state, metrics = compiled_step(state, batch, rng)
+            else:
+                is_compile = getattr(
+                    compiled_step, "_tpudl_compile_pending", False
+                )
+                t0 = clock()
+                state, metrics = compiled_step(state, batch, rng)
+                t1 = clock()
+                if is_compile:
+                    rec.record("compile_step", obs_spans.CAT_COMPILE,
+                               t0, t1 - t0, {"step": i})
+                    h_compile.observe(t1 - t0)
+                else:
+                    rec.record("train_step", obs_spans.CAT_STEP,
+                               t0, t1 - t0, {"step": i})
+                    h_step.observe(t1 - t0)
             if profiling and i + 1 == prof_stop:
                 jax.block_until_ready(metrics)
                 jax.profiler.stop_trace()
@@ -534,9 +634,12 @@ def fit(
                     logger(i + 1, host_metrics)
                 else:
                     print(f"step {i + 1}: {host_metrics}")
+            i += 1
     finally:
         if profiling:
             jax.profiler.stop_trace()
+        if rec is not None:
+            rec.counters(obs_counters.registry().snapshot())
     if checkpoint_manager is not None and n:
         step_no = start_step + n
         if not checkpoint_every or step_no % checkpoint_every != 0:
@@ -582,12 +685,23 @@ def evaluate(
     may_pad = pad_to is not None or getattr(
         compiled_eval_step, "_tpudl_mask_aware", False
     )
+    rec = obs_spans.active_recorder()
     totals: dict = {}
     n_examples = 0
     target = pad_to
-    for i, batch in enumerate(batches):
-        if num_steps is not None and i >= num_steps:
-            break
+    it = iter(batches)
+    i = 0
+    while num_steps is None or i < num_steps:
+        if rec is None:
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+        else:
+            pulled = _obs_pull(rec, it, {"step": i, "phase": "eval"})
+            if pulled is None:
+                break
+            batch = pulled[0]
         bs = next(iter(batch.values())).shape[0]
         if "_valid" in batch:
             # Caller pre-padded: the mask knows the real count.
@@ -598,10 +712,27 @@ def evaluate(
             target = bs
         if bs < target and may_pad:
             batch = pad_batch(batch, target)
-        metrics = compiled_eval_step(state, batch)
+        if rec is None:
+            metrics = compiled_eval_step(state, batch)
+        else:
+            is_compile = getattr(
+                compiled_eval_step, "_tpudl_compile_pending", False
+            )
+            t0 = rec.clock()
+            metrics = compiled_eval_step(state, batch)
+            t1 = rec.clock()
+            # CAT_EVAL, not CAT_STEP: eval steps have their own duration
+            # scale — mixing them into the train-step distribution would
+            # skew the report's outlier and straggler statistics.
+            rec.record(
+                "eval_step",
+                obs_spans.CAT_COMPILE if is_compile else obs_spans.CAT_EVAL,
+                t0, t1 - t0, {"step": i, "phase": "eval"},
+            )
         n_examples += weight
         for k, v in metrics.items():
             totals[k] = totals.get(k, 0.0) + v * weight
+        i += 1
     if n_examples == 0:
         raise ValueError("evaluate() received no batches")
     return {k: float(v) / n_examples for k, v in totals.items()}

@@ -96,9 +96,20 @@ def summarize_trace(
             f"device process {pids[pid]!r} has no complete ('X') events in "
             f"{path} — did the profile window cover any steps?"
         )
-    # The op stream is the thread with the most events (other threads
-    # carry aggregate launch spans that would double-count).
-    tid_counts = collections.Counter(e.get("tid") for e in dev)
+    # The op stream is the thread whose events carry args.hlo_category —
+    # the field this summarizer consumes — with the most events breaking
+    # ties. Launch/annotation threads can carry MORE events than the
+    # HLO-op thread, so most-events alone silently picks the wrong
+    # stream and reports wrong totals; it remains only as the fallback
+    # when NO thread carries the field (then every stream is equally
+    # category-less and the biggest is the least-wrong choice).
+    tid_counts = collections.Counter(
+        e.get("tid")
+        for e in dev
+        if "hlo_category" in (e.get("args") or {})
+    )
+    if not tid_counts:
+        tid_counts = collections.Counter(e.get("tid") for e in dev)
     op_tid = tid_counts.most_common(1)[0][0]
     ops = [e for e in dev if e.get("tid") == op_tid]
 
