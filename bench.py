@@ -338,11 +338,36 @@ def _bench_bert_large():
     )
 
 
+def _bench_input_pipeline():
+    """Host input-pipeline feeding rate (images/sec delivered to the
+    device, model-free — benchmarks/input_pipeline.py): the perf
+    trajectory must capture the feeding rate, not just what the chips do
+    with the batches (an input-bound model regresses here first)."""
+    from benchmarks.input_pipeline import measure_both
+
+    legacy, pipelined = measure_both(
+        rows=8_192, batch_size=256, measure_batches=24
+    )
+    return legacy, pipelined
+
+
 def main():
     bert_sps, bert_mfu = _bench_bert()
     resnet_ips = _bench_resnet()
     resnet50_ips = _bench_resnet50()
     bl_sps, bl_mfu, bl_mfu_compiled = _bench_bert_large()
+    try:
+        pipe_legacy, pipe_new = _bench_input_pipeline()
+    except Exception:
+        # The model metrics above must still report, but a silently-null
+        # feeding-rate field would hide a broken benchmark — leave the
+        # evidence on stderr.
+        import sys
+        import traceback
+
+        print("input-pipeline bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        pipe_legacy = pipe_new = None
 
     vs_baseline = (
         bert_sps / BASELINE_BERT_SAMPLES_PER_SEC
@@ -380,6 +405,18 @@ def main():
                 # the counted-once ratio guard tripped.
                 "bert_large_mfu_compiled": round(bl_mfu_compiled, 4)
                 if bl_mfu_compiled is not None
+                else None,
+                # Host feeding rate (model-free, benchmarks/
+                # input_pipeline.py): uint8-wire two-stage pipeline, with
+                # the pre-overhaul f32 single-worker feed as its ratio
+                # base — the perf trajectory of the INPUT path.
+                "input_pipeline_images_per_sec_host": round(pipe_new, 1)
+                if pipe_new is not None
+                else None,
+                "input_pipeline_vs_legacy_feed": round(
+                    pipe_new / pipe_legacy, 3
+                )
+                if pipe_new is not None and pipe_legacy
                 else None,
             }
         )

@@ -1,0 +1,2 @@
+"""Microbenchmark scripts (runnable standalone; input_pipeline is also
+imported by the root bench.py to record the host feeding rate)."""

@@ -1,0 +1,215 @@
+"""Host input-pipeline throughput, isolated from model FLOPs.
+
+Measures images/sec DELIVERED TO THE DEVICE on the CIFAR-like Parquet
+path — converter read + host transform + H2D placement, no train step —
+so input-pipeline changes are provable independently of what the chips
+do with the batches. Two pipelines over the same materialized dataset:
+
+- **legacy** (the pre-overhaul feed end to end, kept here as the
+  comparison baseline): the pre-PR one-row-group-per-file CIFAR Parquet
+  layout (which kept the converter's reader pool idle — one giant group
+  decodes on one thread), float32 host normalization
+  (``normalize_cifar_batch`` — 4x the H2D bytes), and a single worker
+  thread that serializes batch assembly and ``device_put``;
+- **pipelined**: the overhauled feed end to end: 256-row-group layout
+  (the new ``materialize_cifar10_like`` default — the reader pool
+  actually streams), uint8 wire batches (``wire_cifar_batch``; the
+  normalization runs device-side in real training) through the
+  two-stage ``tpudl.data.prefetch`` pipeline (assembly pool + dedicated
+  transfer stage + data-wait autotuner).
+
+The standalone run also reports ``legacy_on_new_layout`` — the f32
+single-worker feed over the NEW Parquet layout — so the win decomposes
+into its layout vs transfer/pipelining parts instead of hiding one
+inside the other.
+
+Usage (from the repo root):
+
+    python benchmarks/input_pipeline.py [rows] [batch] [measure_batches]
+
+Prints one JSON line; ``speedup`` is pipelined/legacy (post-PR feed over
+pre-PR feed). Also importable — ``bench.py`` calls ``measure_both`` to
+record the feeding rate next to the model-throughput metrics every
+driver round.
+"""
+
+import json
+import queue
+import sys
+import tempfile
+import threading
+import time
+
+
+def _legacy_prefetch(iterator, prefetch=2):
+    """The pre-overhaul prefetch_to_device, verbatim (single worker:
+    host assembly and device_put serialize on one thread; error raised
+    only after the queue drains) — the benchmark's baseline."""
+    import jax
+
+    q = queue.Queue(maxsize=max(prefetch, 1))
+    sentinel = object()
+    errors = []
+
+    def worker():
+        try:
+            for batch in iterator:
+                q.put(jax.device_put(batch))
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            q.put(sentinel)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is sentinel:
+            if errors:
+                raise errors[0]
+            return
+        yield item
+
+
+def _drain(device_batches, batch_size, measure_batches, warmup_batches=4,
+           exhaust=False):
+    """images/sec over ``measure_batches`` device-blocked pulls, first
+    ``warmup_batches`` excluded (pipeline fill + allocator warmup).
+
+    ``exhaust`` pulls any remaining batches after the timed window —
+    required for the legacy generator, whose worker thread would
+    otherwise stay blocked on its full queue for the life of the
+    process (the exact leak the overhaul fixes; the DevicePrefetcher
+    side reaps its workers via close())."""
+    import jax
+
+    it = iter(device_batches)
+    for _ in range(warmup_batches):
+        jax.block_until_ready(next(it))
+    t0 = time.perf_counter()
+    for _ in range(measure_batches):
+        jax.block_until_ready(next(it))
+    elapsed = time.perf_counter() - t0
+    if exhaust:
+        for _ in it:
+            pass
+    closer = getattr(device_batches, "close", None) or getattr(
+        it, "close", None
+    )
+    if closer is not None:
+        closer()
+    return batch_size * measure_batches / elapsed
+
+
+def measure_legacy(conv, batch_size, measure_batches, warmup_batches=4):
+    """The pre-PR feed over ``conv``: f32 host normalize, default reader
+    pool (idle on the pre-PR one-group-per-file layout), single-worker
+    prefetch. The source is BOUNDED (islice) and drained past the timed
+    window so the legacy worker thread exits instead of leaking."""
+    import itertools
+
+    from tpudl.data.datasets import normalize_cifar_batch
+
+    raw = conv.make_batch_iterator(
+        batch_size, epochs=None, shuffle=False, shard_index=0, num_shards=1,
+        transform=normalize_cifar_batch,
+    )
+    raw = itertools.islice(raw, warmup_batches + measure_batches + 2)
+    return _drain(
+        _legacy_prefetch(raw, prefetch=2), batch_size, measure_batches,
+        warmup_batches=warmup_batches, exhaust=True,
+    )
+
+
+def measure_pipelined(conv, batch_size, measure_batches, assembly_workers=4):
+    """The overhauled feed over ``conv``: uint8 wire + a wider reader
+    pool (the overhaul's streaming layout gives it row groups to
+    overlap) + two-stage autotuned prefetch."""
+    from tpudl.data.datasets import wire_cifar_batch
+    from tpudl.data.prefetch import prefetch_to_device
+
+    raw = conv.make_batch_iterator(
+        batch_size, epochs=None, shuffle=False, shard_index=0, num_shards=1,
+        num_reader_threads=6,
+    )
+    return _drain(
+        prefetch_to_device(
+            raw, prefetch=2, transform=wire_cifar_batch,
+            assembly_workers=assembly_workers, autotune=True,
+        ),
+        batch_size,
+        measure_batches,
+    )
+
+
+def _materialize_pre_pr(directory, rows):
+    """The exact pre-PR CIFAR dataset layout: 2048-row files, one row
+    group per file (row_group_size=None)."""
+    from tpudl.data.datasets import materialize_cifar10_like
+
+    return materialize_cifar10_like(
+        directory, num_rows=rows, rows_per_file=2048, row_group_size=None
+    )
+
+
+def _materialize_post_pr(directory, rows):
+    """The overhauled layout: 4096-row files at the new 256-row-group
+    default (file boundaries drain the reader pool's window, so fewer,
+    larger files stream better)."""
+    from tpudl.data.datasets import materialize_cifar10_like
+
+    return materialize_cifar10_like(directory, num_rows=rows,
+                                    rows_per_file=4096)
+
+
+def measure_both(rows=8_192, batch_size=256, measure_batches=24):
+    """Materialize pre-PR- and post-PR-layout CIFAR datasets in temp
+    dirs and measure each era's full feed over its own layout; returns
+    (legacy_ips, pipelined_ips)."""
+    with tempfile.TemporaryDirectory() as d_old, (
+        tempfile.TemporaryDirectory()
+    ) as d_new:
+        legacy = measure_legacy(
+            _materialize_pre_pr(d_old, rows), batch_size, measure_batches
+        )
+        pipelined = measure_pipelined(
+            _materialize_post_pr(d_new, rows), batch_size, measure_batches
+        )
+        return legacy, pipelined
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 12_288
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    n = int(sys.argv[3]) if len(sys.argv) > 3 else 32
+    with tempfile.TemporaryDirectory() as d_old, (
+        tempfile.TemporaryDirectory()
+    ) as d_new:
+        conv_old = _materialize_pre_pr(d_old, rows)
+        conv_new = _materialize_post_pr(d_new, rows)
+        legacy = measure_legacy(conv_old, batch, n)
+        ablation = measure_legacy(conv_new, batch, n)
+        pipelined = measure_pipelined(conv_new, batch, n)
+    print(
+        json.dumps(
+            {
+                "metric": "input_pipeline_images_per_sec",
+                "legacy_f32_single_worker": round(legacy, 1),
+                # Layout-only ablation: the old feed over the NEW layout
+                # — separates the Parquet-layout win from the
+                # wire-dtype/pipelining win.
+                "legacy_on_new_layout": round(ablation, 1),
+                "pipelined_uint8_two_stage": round(pipelined, 1),
+                "speedup": round(pipelined / legacy, 3),
+                "speedup_same_layout": round(pipelined / ablation, 3),
+                "batch": batch,
+                "measure_batches": n,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    import pathlib
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    main()

@@ -196,10 +196,14 @@ def main():
             pad=4 if is_cifar else 8, seed=cfg.seed,
             mean=norm_mean, std=norm_std, normalize=False,
         )
+        # Augmentation is passed to the PREFETCHER (below), not the
+        # converter: converter transforms run inside the source lock,
+        # one at a time; the prefetcher's assembly pool crops/flips N
+        # batches in parallel.
         raw = train_conv.make_batch_iterator(
             batch_size, epochs=None, shuffle=True, seed=cfg.seed,
-            transform=augment,
         )
+        host_transform = augment
 
         # Eval path: SAME device normalization, center crop, no flip.
         eval_augment = BatchAugmenter(
@@ -217,6 +221,7 @@ def main():
             batch_divisor=mesh.shape["dp"] * mesh.shape["fsdp"],
         )
     else:
+        host_transform = None  # synthetic stream is already f32
         raw = synthetic_classification_batches(
             batch_size,
             image_shape=(cfg.image_size, cfg.image_size, 3),
@@ -251,12 +256,20 @@ def main():
 
     # Prefetch either stream: explicit placement overlaps the host->device
     # transfer with compute (jit's implicit numpy-arg transfer is
-    # pathologically slow on relay-attached devices).
+    # pathologically slow on relay-attached devices). Parquet-fed runs
+    # get an assembly pool (row-group decode + uint8 augmentation
+    # parallelize host-side); the in-memory synthetic stream needs none.
+    # Depth autotunes off the data-wait p95 (TPUDL_PREFETCH_DEPTH pins).
     # Fast-forward a resumed run on the HOST side (before device
     # prefetch) so skipped batches never pay a transfer.
     if start_step:
         raw = itertools.islice(iter(raw), start_step, None)
-    batches = iter(prefetch_to_device(raw, mesh=mesh))
+    batches = iter(
+        prefetch_to_device(
+            raw, mesh=mesh, transform=host_transform,
+            assembly_workers=4 if host_transform is not None else 1,
+        )
+    )
     rng = jax.random.key(cfg.seed + 1)
 
     logger = None

@@ -237,12 +237,12 @@ def main():
                 text_dir, ids_dir, tok, seq_len=seq_len
             )
         conv, eval_conv = split_train_eval(conv)
-        raw = (
-            normalize_sst2_batch(b)
-            for b in conv.make_batch_iterator(
-                batch_size, epochs=None, shuffle=True, seed=cfg.seed
-            )
+        # Wire casts run in the prefetcher's assembly pool (parallel,
+        # outside the source lock), not inside the source iterator.
+        raw = conv.make_batch_iterator(
+            batch_size, epochs=None, shuffle=True, seed=cfg.seed
         )
+        host_transform = normalize_sst2_batch
         eval_raw = eval_stream(
             eval_conv, batch_size, normalize_sst2_batch,
             batch_divisor=mesh.shape["dp"] * mesh.shape["fsdp"],
@@ -258,17 +258,18 @@ def main():
         else:
             conv = make_converter(args.data_dir)
         conv, eval_conv = split_train_eval(conv)
-        raw = (
-            normalize_sst2_batch(b)
-            for b in conv.make_batch_iterator(
-                batch_size, epochs=None, shuffle=True, seed=cfg.seed
-            )
+        # Wire casts run in the prefetcher's assembly pool (parallel,
+        # outside the source lock), not inside the source iterator.
+        raw = conv.make_batch_iterator(
+            batch_size, epochs=None, shuffle=True, seed=cfg.seed
         )
+        host_transform = normalize_sst2_batch
         eval_raw = eval_stream(
             eval_conv, batch_size, normalize_sst2_batch,
             batch_divisor=mesh.shape["dp"] * mesh.shape["fsdp"],
         )
     else:
+        host_transform = None  # synthetic stream is already wire-ready
         raw = synthetic_token_batches(
             batch_size,
             seq_len=seq_len,
@@ -309,7 +310,13 @@ def main():
 
     if start_step:
         raw = itertools.islice(iter(raw), start_step, None)
-    batches = prefetch_to_device(raw, mesh=mesh)
+    # The int64->int32 token casts run in the prefetcher's assembly pool
+    # (outside the source lock, overlapped with the transfer stage);
+    # depth autotunes off data-wait (TPUDL_PREFETCH_DEPTH pins it).
+    batches = prefetch_to_device(
+        raw, mesh=mesh, transform=host_transform,
+        assembly_workers=2 if host_transform else 1,
+    )
     rng = jax.random.key(cfg.seed + 1)
 
     logger = None

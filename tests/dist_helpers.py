@@ -114,8 +114,9 @@ def converter_fed_train(data_dir, local_batch=16):
     import jax.numpy as jnp
     import optax
 
-    from tpudl.data.converter import make_converter, prefetch_to_device
-    from tpudl.data.datasets import normalize_cifar_batch
+    from tpudl.data.converter import make_converter
+    from tpudl.data.datasets import device_normalize_cifar, wire_cifar_batch
+    from tpudl.data.prefetch import prefetch_to_device
     from tpudl.models.resnet import ResNetTiny
     from tpudl.runtime.mesh import MeshSpec, make_mesh
     from tpudl.train import (
@@ -131,7 +132,14 @@ def converter_fed_train(data_dir, local_batch=16):
     state = create_train_state(
         jax.random.key(0), model, jnp.zeros((1, 32, 32, 3)), optax.sgd(0.05)
     )
-    step = compile_step(make_classification_train_step(), mesh, state, None)
+    # Wire dtype stays uint8 across the process-local -> global-array
+    # boundary; normalization happens device-side inside the step.
+    step = compile_step(
+        make_classification_train_step(
+            input_transform=device_normalize_cifar()
+        ),
+        mesh, state, None,
+    )
 
     rows = {"n": 0}
 
@@ -143,7 +151,6 @@ def converter_fed_train(data_dir, local_batch=16):
             drop_last=True,
             shard_index=jax.process_index(),
             num_shards=jax.process_count(),
-            transform=normalize_cifar_batch,
         ):
             rows["n"] += len(batch["label"])
             yield batch
@@ -156,12 +163,68 @@ def converter_fed_train(data_dir, local_batch=16):
     state, metrics, info = fit(
         step,
         state,
-        prefetch_to_device(counted(), mesh=mesh),
+        prefetch_to_device(
+            counted(), mesh=mesh, transform=wire_cifar_batch,
+            assembly_workers=2,
+        ),
         jax.random.key(1),
         log_every=1,
         logger=log,
     )
     return losses, rows["n"]
+
+
+def prefetch_multicolumn_global(local_batch=8, num_batches=6):
+    """Multi-column batches through the TWO-STAGE prefetch's multi-host
+    path (jax.make_array_from_process_local_data): every rank feeds
+    uint8 image + int32 label + float32 weight columns and reports the
+    GLOBAL shapes, dtypes, per-column global sums, and the order marker
+    column — ranks must agree on all of them (each rank addresses only
+    its shard; the sums force a cross-process reduction)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpudl.data.prefetch import prefetch_to_device
+
+    rank = jax.process_index()
+
+    def batches():
+        for i in range(num_batches):
+            base = i * 1000 + rank * 100
+            yield {
+                "image": np.full(
+                    (local_batch, 4, 4, 3), i + 1, dtype=np.uint8
+                ),
+                "label": np.full((local_batch,), base, dtype=np.int32),
+                "weight": np.full((local_batch,), float(i), np.float32),
+                "order": np.full((local_batch,), i, dtype=np.int32),
+            }
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(dp=-1))
+    # Explicit shardings: the localhost multi-process CPU backend only
+    # runs cross-process computations through pjit-annotated programs.
+    sum_fn = jax.jit(
+        lambda b: {k: jnp.sum(b[k].astype(jnp.float32)) for k in b},
+        in_shardings=NamedSharding(mesh, P(("dp", "fsdp"))),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    out = []
+    for gb in prefetch_to_device(batches(), mesh=mesh, assembly_workers=3):
+        summed = sum_fn(gb)
+        out.append(
+            {
+                "shapes": {k: tuple(v.shape) for k, v in gb.items()},
+                "dtypes": {k: str(v.dtype) for k, v in gb.items()},
+                "sums": {k: float(v) for k, v in summed.items()},
+                "order": int(np.asarray(gb["order"].addressable_data(0))[0]),
+            }
+        )
+    return out
 
 
 def _ckpt_state():

@@ -187,3 +187,69 @@ def test_split_train_eval_guards_and_small_holdout(tmp_path):
     # holdout has 20 rows < batch 64: partial batch kept, not zero batches
     batches = list(eval_stream(holdout, 64, lambda b: b)())
     assert len(batches) == 1 and len(batches[0]["row_id"]) == 20
+
+
+def test_eval_stream_batch_divisor_trims_and_skips(tmp_path):
+    """batch_divisor (the mesh's dp*fsdp batch-shard count) must trim
+    partial batches to a divisible row count and SKIP sub-divisor
+    remainders — an indivisible tail batch would fail pjit's
+    divisibility check on a sharded mesh."""
+    from tpudl.data.datasets import eval_stream
+    from tpudl.data.converter import make_converter, write_parquet
+
+    ids = np.arange(22, dtype=np.int64)
+    write_parquet(str(tmp_path), {"row_id": ids}, rows_per_file=1024)
+    holdout = make_converter(str(tmp_path))
+
+    # A full batch fits: drop_last engages, every batch is already
+    # divisible — the divisor changes nothing.
+    stream = eval_stream(holdout, 8, lambda b: b, batch_divisor=4)
+    assert [len(b["row_id"]) for b in stream()] == [8, 8]
+    # Re-iterable (evaluate drains one epoch per call).
+    assert [len(b["row_id"]) for b in stream()] == [8, 8]
+
+    # Sub-batch holdout (22 < 64): the partial 22-row batch is kept and
+    # TRIMMED down to the divisor multiple.
+    assert [
+        len(b["row_id"])
+        for b in eval_stream(holdout, 64, lambda b: b, batch_divisor=4)()
+    ] == [20]
+    assert [
+        len(b["row_id"])
+        for b in eval_stream(holdout, 64, lambda b: b, batch_divisor=8)()
+    ] == [16]
+    # Divisor larger than the whole holdout: batch skipped entirely
+    # (at most divisor-1 rows go unevaluated).
+    assert (
+        list(eval_stream(holdout, 64, lambda b: b, batch_divisor=32)())
+        == []
+    )
+    # The normalize hook runs on the TRIMMED batch.
+    (normed,) = eval_stream(
+        holdout, 64, lambda b: dict(b, row_id=b["row_id"] + 1),
+        batch_divisor=4,
+    )()
+    assert normed["row_id"].tolist() == [i + 1 for i in range(20)]
+
+
+def test_wire_and_device_normalize_match_host_path(tmp_path):
+    """wire_cifar_batch + device_normalize_cifar must train on EXACTLY
+    the arithmetic of the host normalize_cifar_batch path — same scale/
+    bias in f32 — while shipping uint8 over the wire."""
+    import jax
+
+    from tpudl.data.datasets import device_normalize_cifar, wire_cifar_batch
+
+    conv = materialize_cifar10_like(str(tmp_path / "c10"), num_rows=128)
+    batch = next(conv.make_batch_iterator(32, shard_index=0, num_shards=1))
+    wire = wire_cifar_batch(batch)
+    assert wire["image"].dtype == np.uint8  # 4x fewer H2D bytes
+    assert wire["label"].dtype == np.int32
+    on_device = jax.jit(device_normalize_cifar())(wire)
+    host = normalize_cifar_batch(batch)
+    np.testing.assert_allclose(
+        np.asarray(on_device["image"]), host["image"], rtol=0, atol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(on_device["label"]), host["label"]
+    )

@@ -94,6 +94,37 @@ def test_spawn_converter_fed_training(tmp_path):
 
 
 @pytest.mark.slow
+def test_spawn_prefetch_multicolumn_global():
+    """Multi-column batches through the two-stage prefetch's multi-host
+    make_array_from_process_local_data path: global shapes/dtypes, exact
+    cross-process sums, and source ORDER (the assembly pool must not
+    reorder) agree on every rank."""
+    local_batch, num_batches = 8, 6
+    d = TpuDistributor(num_processes=2, platform="cpu", devices_per_process=2)
+    r0, r1 = d.run(
+        dist_helpers.prefetch_multicolumn_global, local_batch, num_batches
+    )
+    assert len(r0) == len(r1) == num_batches
+    for i, (a, b) in enumerate(zip(r0, r1)):
+        # Both ranks observed the same GLOBAL batch, in source order.
+        assert a == b
+        assert a["order"] == i
+        assert a["shapes"] == {
+            "image": (16, 4, 4, 3),
+            "label": (16,),
+            "weight": (16,),
+            "order": (16,),
+        }
+        assert a["dtypes"]["image"] == "uint8"
+        assert a["dtypes"]["label"] == "int32"
+        assert a["dtypes"]["weight"] == "float32"
+        # label: rank 0 contributes 8*(i*1000), rank 1 adds 8*(i*1000+100).
+        assert a["sums"]["label"] == 8 * (i * 1000) + 8 * (i * 1000 + 100)
+        assert a["sums"]["image"] == 16 * 4 * 4 * 3 * (i + 1)
+        assert a["sums"]["weight"] == 16.0 * i
+
+
+@pytest.mark.slow
 def test_spawn_checkpoint_save_resume(tmp_path):
     """Multi-process checkpoint/resume — the actual pod recovery story
     (SURVEY.md §5.3-5.4): 2 spawned JAX processes train and save through

@@ -1,0 +1,363 @@
+"""Two-stage pipelined prefetch + data-wait autotuner
+(tpudl.data.prefetch): ordering, shutdown/thread-reaping, prompt error
+propagation, and depth autotuning — the round-5 input-pipeline overhaul's
+contract surface."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpudl.data.prefetch import (
+    DevicePrefetcher,
+    PrefetchAutotuner,
+    prefetch_to_device,
+)
+
+_THREAD_PREFIX = "tpudl-prefetch"
+
+
+def _alive_prefetch_threads():
+    return [
+        t
+        for t in threading.enumerate()
+        if t.name.startswith(_THREAD_PREFIX) and t.is_alive()
+    ]
+
+
+def _wait_no_prefetch_threads(timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _alive_prefetch_threads():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _batches(n, batch=4, columns=("image", "label")):
+    for i in range(n):
+        out = {}
+        if "image" in columns:
+            out["image"] = np.full((batch, 3, 3, 2), i, np.uint8)
+        if "label" in columns:
+            out["label"] = np.full((batch,), i, np.int64)
+        if "weight" in columns:
+            out["weight"] = np.full((batch,), float(i), np.float32)
+        yield out
+
+
+def test_order_and_completeness_single_worker():
+    got = [int(b["label"][0]) for b in prefetch_to_device(_batches(12))]
+    assert got == list(range(12))
+    assert _wait_no_prefetch_threads()
+
+
+def test_order_preserved_with_assembly_pool():
+    """Any worker count must yield the exact single-threaded sequence
+    (sequence tickets reorder at the transfer stage)."""
+
+    def jittery(batch):
+        # Uneven per-batch transform latency scrambles completion order.
+        time.sleep(0.001 * (int(batch["label"][0]) % 3))
+        return batch
+
+    it = prefetch_to_device(
+        _batches(20), transform=jittery, assembly_workers=4
+    )
+    got = [int(b["label"][0]) for b in it]
+    assert got == list(range(20))
+
+
+def test_multi_column_batches_and_dtypes(mesh8):
+    """Multi-column batches through the mesh path: every column becomes a
+    global array sharded over the (dp, fsdp) batch axes, uint8 stays
+    uint8 on the wire."""
+    it = prefetch_to_device(
+        _batches(6, batch=8, columns=("image", "label", "weight")),
+        mesh=mesh8,
+        assembly_workers=2,
+    )
+    count = 0
+    for i, b in enumerate(it):
+        assert set(b) == {"image", "label", "weight"}
+        assert b["image"].shape == (8, 3, 3, 2)
+        assert b["image"].dtype == np.uint8
+        assert b["weight"].dtype == np.float32
+        for v in b.values():
+            assert v.sharding.spec[0] == ("dp", "fsdp")
+        assert int(np.asarray(b["label"])[0]) == i
+        count += 1
+    assert count == 6
+
+
+def test_transform_runs_in_pipeline():
+    it = prefetch_to_device(
+        _batches(5),
+        transform=lambda b: {**b, "label": b["label"] + 100},
+    )
+    assert [int(b["label"][0]) for b in it] == [100, 101, 102, 103, 104]
+
+
+def test_close_reaps_blocked_workers():
+    """Round-5 satellite: a consumer that stops early must not leak a
+    worker blocked forever on a full queue. The source here is infinite,
+    so the workers are guaranteed to be blocked mid-pipeline when the
+    consumer walks away."""
+
+    def infinite():
+        i = 0
+        while True:
+            yield {"x": np.full((4,), i, np.int32)}
+            i += 1
+
+    it = prefetch_to_device(infinite(), prefetch=2, assembly_workers=2)
+    assert int(np.asarray(next(it)["x"])[0]) == 0
+    assert _alive_prefetch_threads()  # pipeline genuinely running
+    it.close()
+    assert _wait_no_prefetch_threads(), "prefetch workers leaked after close"
+    with pytest.raises(StopIteration):
+        next(it)
+    it.close()  # idempotent
+
+
+def test_abandoned_handle_reaps_workers():
+    """Dropping the handle without close() must still reap the workers:
+    the threads reference only the internal pipeline (never the handle),
+    so the handle's finalizer can actually fire — a thread holding a
+    bound method of the handle would pin it alive forever."""
+    import gc
+
+    def infinite():
+        while True:
+            yield {"x": np.zeros((2,), np.float32)}
+
+    it = prefetch_to_device(infinite(), assembly_workers=2)
+    next(it)
+    assert _alive_prefetch_threads()
+    del it
+    deadline = time.monotonic() + 5.0
+    while _alive_prefetch_threads() and time.monotonic() < deadline:
+        gc.collect()
+        time.sleep(0.05)
+    assert not _alive_prefetch_threads(), "abandoned prefetcher leaked"
+
+
+def test_context_manager_and_break():
+    def infinite():
+        while True:
+            yield {"x": np.zeros((2,), np.float32)}
+
+    with prefetch_to_device(infinite()) as it:
+        for n, _ in enumerate(it):
+            if n >= 3:
+                break
+    assert _wait_no_prefetch_threads()
+
+
+def test_error_propagates_promptly():
+    """Round-5 satellite: a worker exception surfaces on the consumer's
+    NEXT pull — not after every already-queued batch drains."""
+
+    def bad():
+        for i in range(3):
+            yield {"x": np.full((2,), i, np.float32)}
+        raise RuntimeError("reader exploded")
+
+    # Queue deep enough that the worker queues all 3 batches AND reaches
+    # the raise without the consumer pulling anything.
+    it = prefetch_to_device(bad(), prefetch=8)
+    deadline = time.monotonic() + 5.0
+    while it._error is None and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert it._error is not None
+    # 3 good batches are queued ahead of the failure; the error must
+    # still win the consumer's very next pull (the old implementation
+    # made it wait behind the whole queue).
+    with pytest.raises(RuntimeError, match="reader exploded"):
+        next(it)
+    assert _wait_no_prefetch_threads()
+
+
+def test_transform_error_propagates():
+    def boom(batch):
+        raise ValueError("transform exploded")
+
+    it = prefetch_to_device(_batches(3), transform=boom)
+    with pytest.raises(ValueError, match="transform exploded"):
+        list(it)
+    assert _wait_no_prefetch_threads()
+
+
+def test_transform_stopiteration_surfaces_as_runtimeerror():
+    """A transform leaking StopIteration must NOT read as clean source
+    exhaustion (silent training truncation) — the prefetcher is a plain
+    iterator, so PEP 479 wouldn't save it."""
+
+    def leaky(batch):
+        raise StopIteration
+
+    it = prefetch_to_device(_batches(3), transform=leaky)
+    with pytest.raises(RuntimeError, match="StopIteration"):
+        list(it)
+    assert _wait_no_prefetch_threads()
+
+
+def test_straggling_transform_bounds_host_buffering():
+    """One stuck transform must not let the other assembly workers
+    stream the whole source into the transfer stage's reorder buffer:
+    the ticket window parks them, bounding pulled-ahead batches."""
+    release = threading.Event()
+    pulled = {"n": 0}
+
+    def src():
+        for i in range(100):
+            pulled["n"] += 1
+            yield {"x": np.full((2,), i, np.int32)}
+
+    def transform(batch):
+        if int(batch["x"][0]) == 0:
+            assert release.wait(10)
+        return batch
+
+    it = prefetch_to_device(
+        src(), prefetch=2, assembly_workers=4, transform=transform
+    )
+    # Give the non-straggler workers time to run as far as they're
+    # allowed while ticket 0 is stuck.
+    time.sleep(0.3)
+    # Window = host_depth(6) + workers(4) + depth(2) = 12 tickets ahead
+    # of emit, plus one in-flight pull per worker. Pre-fix this was 100.
+    assert pulled["n"] <= 20, pulled["n"]
+    release.set()
+    assert [int(np.asarray(b["x"])[0]) for b in it] == list(range(100))
+
+
+def test_empty_source():
+    assert list(prefetch_to_device(_batches(0))) == []
+
+
+def test_env_depth_override(monkeypatch):
+    monkeypatch.setenv("TPUDL_PREFETCH_DEPTH", "5")
+    it = prefetch_to_device(_batches(3), prefetch=2)
+    assert it.depth == 5
+    assert it._autotuner is None  # pinned depth disables autotuning
+    assert len(list(it)) == 3
+
+
+class TestAutotuner:
+    def test_grows_while_starved(self):
+        at = PrefetchAutotuner(depth=2, max_depth=6, target_wait_s=0.01,
+                               window=4)
+        at.observe(9.9, 1000)  # first pull: pipeline fill, ignored
+        for _ in range(3 * 4):
+            at.observe(0.05, 1000)  # p95 far above 10 ms
+        assert at.depth == 5  # +1 per full window
+        assert [d[1:3] for d in at.decisions] == [(2, 3), (3, 4), (4, 5)]
+
+    def test_holds_when_fed(self):
+        at = PrefetchAutotuner(depth=2, max_depth=6, target_wait_s=0.01,
+                               window=4)
+        for _ in range(40):
+            at.observe(0.001, 1000)
+        assert at.depth == 2 and not at.decisions
+
+    def test_respects_max_depth(self):
+        at = PrefetchAutotuner(depth=2, max_depth=3, target_wait_s=0.001,
+                               window=2)
+        for _ in range(20):
+            at.observe(1.0, 100)
+        assert at.depth == 3
+
+    def test_respects_byte_budget(self):
+        # 3 slots x 500 bytes would blow the 1200-byte budget: stay at 2.
+        at = PrefetchAutotuner(depth=2, max_depth=8, target_wait_s=0.001,
+                               byte_budget=1200, window=2)
+        for _ in range(20):
+            at.observe(1.0, 500)
+        assert at.depth == 2 and not at.decisions
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            PrefetchAutotuner(depth=4, max_depth=2)
+
+    def test_autotuned_prefetcher_grows_capacity(self):
+        """End-to-end: a slow source starves the consumer; the device
+        queue's capacity must grow across the run."""
+
+        def slow():
+            for i in range(40):
+                time.sleep(0.002)
+                yield {"x": np.full((2,), i, np.int32)}
+
+        at = PrefetchAutotuner(depth=1, max_depth=4, target_wait_s=1e-4,
+                               window=4)
+        it = DevicePrefetcher(slow(), depth=1, autotuner=at)
+        n = sum(1 for _ in it)
+        assert n == 40
+        assert it.depth > 1, "depth never grew despite constant starvation"
+
+
+def test_fit_drives_prefetcher_and_records_data_wait(tmp_path):
+    """The training-loop integration: fit() over a two-stage prefetcher
+    with device-side normalization records data_wait spans and the
+    prefetcher reports its depth gauge into the obs registry."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.data.datasets import device_normalize_cifar, wire_cifar_batch
+    from tpudl.models.resnet import ResNetTiny
+    from tpudl.obs import counters as obs_counters
+    from tpudl.obs import spans as obs_spans
+    from tpudl.train import (
+        compile_step,
+        create_train_state,
+        fit,
+        make_classification_train_step,
+    )
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+
+    def cifar_batches(n):
+        rng = np.random.default_rng(0)
+        for _ in range(n):
+            yield {
+                "image": rng.integers(0, 256, (16, 32, 32, 3)).astype(
+                    np.uint8
+                ),
+                "label": rng.integers(0, 10, (16,)).astype(np.int64),
+            }
+
+    obs_counters.registry().reset()
+    rec = obs_spans.enable(str(tmp_path / "spans.jsonl"))
+    try:
+        mesh = make_mesh(MeshSpec(dp=-1))
+        model = ResNetTiny(num_classes=10)
+        state = create_train_state(
+            jax.random.key(0), model, jnp.zeros((1, 32, 32, 3)),
+            optax.sgd(0.05),
+        )
+        step = compile_step(
+            make_classification_train_step(
+                input_transform=device_normalize_cifar()
+            ),
+            mesh, state, None,
+        )
+        it = prefetch_to_device(
+            cifar_batches(6), mesh=mesh,
+            transform=wire_cifar_batch, assembly_workers=2,
+        )
+        state, metrics, info = fit(step, state, it, jax.random.key(1))
+        assert info["steps"] == 6
+        assert np.isfinite(metrics["loss"])
+        snap = obs_counters.registry().snapshot()
+        assert snap["histograms"]["data_wait_s"]["count"] == 6
+        assert snap["gauges"]["prefetch_depth"] >= 2
+        assert snap["counters"]["prefetch_h2d_bytes"] == 6 * (
+            16 * 32 * 32 * 3 + 16 * 4
+        )
+        spans = [r for r in rec.records if r.get("kind") == "span"]
+        assert sum(1 for s in spans if s["cat"] == "data_wait") == 6
+    finally:
+        obs_spans.disable()
+        obs_counters.registry().reset()

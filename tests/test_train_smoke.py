@@ -249,3 +249,49 @@ def test_evaluate_never_pads_into_mask_unaware_step():
     out = evaluate(mask_aware_step, state=None, batches=batches, pad_to=8)
     assert padded_seen == [8, 8, 8]
     np.testing.assert_allclose(out["loss"], 2.0, rtol=1e-6)
+
+
+def test_compile_step_preprocess_runs_inside_jit(mesh8):
+    """The device-side preprocessing hook: uint8 wire batches through
+    compile_step(preprocess=...) must produce EXACTLY the step outputs of
+    host-normalized f32 batches (same arithmetic, traced into the same
+    executable), for train (has_rng) and eval (mask-aware marker
+    preserved) steps alike."""
+    from tpudl.data.datasets import (
+        device_normalize_cifar,
+        normalize_cifar_batch,
+        wire_cifar_batch,
+    )
+
+    rng_np = np.random.default_rng(0)
+    raw = {
+        "image": rng_np.integers(0, 256, (16, 16, 16, 3)).astype(np.uint8),
+        "label": rng_np.integers(0, 4, (16,)).astype(np.int64),
+    }
+
+    def run(step_factory, batch, **kwargs):
+        state = _make_state()
+        step = compile_step(
+            step_factory, mesh8, state, None, donate_state=False, **kwargs
+        )
+        _, metrics = step(state, batch, jax.random.key(1))
+        return {k: float(v) for k, v in metrics.items()}
+
+    wired = run(
+        make_classification_train_step(),
+        wire_cifar_batch(raw),
+        preprocess=device_normalize_cifar(),
+    )
+    hosted = run(make_classification_train_step(), normalize_cifar_batch(raw))
+    assert wired == pytest.approx(hosted, rel=1e-5)
+
+    # Eval shape: preprocess composes with has_rng=False and keeps the
+    # mask-aware marker (evaluate()'s padding decision reads it).
+    state = _make_state()
+    eval_step = compile_step(
+        make_classification_eval_step(), mesh8, state, None,
+        has_rng=False, preprocess=device_normalize_cifar(),
+    )
+    assert eval_step._tpudl_mask_aware
+    m = eval_step(state, wire_cifar_batch(raw))
+    assert np.isfinite(float(m["loss"]))

@@ -4,8 +4,12 @@ from tpudl.data.augment import BatchAugmenter  # noqa: F401
 from tpudl.data.converter import (  # noqa: F401
     Converter,
     make_converter,
-    prefetch_to_device,
     write_parquet,
+)
+from tpudl.data.prefetch import (  # noqa: F401
+    DevicePrefetcher,
+    PrefetchAutotuner,
+    prefetch_to_device,
 )
 from tpudl.data.ingest import (  # noqa: F401
     ingest_cifar10,

@@ -13,12 +13,18 @@ same f32 scale/bias formulation — so native vs numpy can never change
 training beyond float32 rounding (parity asserted at 1e-6 in
 tests/test_augment.py).
 
-Wiring: ``Converter.make_batch_iterator(transform=BatchAugmenter(...))``
-applies it on the host, per batch, before device transfer.
+Wiring: pass it as ``prefetch_to_device(transform=BatchAugmenter(...))``
+so the prefetcher's assembly pool crops/flips batches in parallel
+(``Converter.make_batch_iterator(transform=...)`` also works, serially
+inside the reader). Draws are lock-protected, so concurrent callers are
+safe; under a multi-worker pool the draw->batch assignment follows
+completion order, so augmentation stays correctly distributed but is
+only bit-reproducible for a fixed seed with ONE worker.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -145,6 +151,9 @@ class BatchAugmenter:
         #: std) as the train step's input_transform (4x less H2D traffic).
         self.normalize = normalize
         self._rng = np.random.default_rng(seed)
+        # numpy Generators are not thread-safe; the prefetcher's
+        # assembly pool calls __call__ concurrently.
+        self._rng_lock = threading.Lock()
         self._mean = np.ascontiguousarray(mean, np.float32)
         self._std = np.ascontiguousarray(std, np.float32)
 
@@ -202,16 +211,19 @@ class BatchAugmenter:
                 f"crop {self.crop} larger than padded image "
                 f"({h + 2 * self.pad}, {w + 2 * self.pad})"
             )
-        offsets = np.stack(
-            [
-                self._rng.integers(0, max_top + 1, n),
-                self._rng.integers(0, max_left + 1, n),
-            ],
-            axis=1,
-        ).astype(np.int32)
-        flip = (
-            self._rng.random(n) < 0.5 if self.hflip else np.zeros(n, bool)
-        ).astype(np.uint8)
+        with self._rng_lock:
+            offsets = np.stack(
+                [
+                    self._rng.integers(0, max_top + 1, n),
+                    self._rng.integers(0, max_left + 1, n),
+                ],
+                axis=1,
+            ).astype(np.int32)
+            flip = (
+                self._rng.random(n) < 0.5
+                if self.hflip
+                else np.zeros(n, bool)
+            ).astype(np.uint8)
 
         if lib is None:
             return _augment_numpy(

@@ -25,7 +25,6 @@ import dataclasses
 import json
 import math
 import os
-import queue
 import threading
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -419,60 +418,14 @@ def make_converter(source: str | Sequence[str]) -> Converter:
 
 
 # ---------------------------------------------------------------------------
-# Device prefetch.
+# Device prefetch (tpudl.data.prefetch — re-exported for the historical
+# import path; the old single-worker implementation serialized host batch
+# assembly and device_put on one thread and lives on only as the
+# benchmarks/input_pipeline.py comparison baseline).
 # ---------------------------------------------------------------------------
 
-
-def prefetch_to_device(
-    iterator: Iterator[Dict[str, np.ndarray]],
-    mesh=None,
-    prefetch: int = 2,
-) -> Iterator[Dict]:
-    """Overlap host batch assembly + H2D transfer with device compute.
-
-    A background thread stages up to `prefetch` batches onto the devices.
-    With a mesh, each process's local batch becomes its addressable shard of
-    a global array sharded over the (dp, fsdp) batch axes
-    (jax.make_array_from_process_local_data — the multi-host feeding path);
-    without one, plain device_put.
-    """
-    import jax
-
-    sharding = None
-    if mesh is not None:
-        from jax.sharding import NamedSharding
-
-        from tpudl.runtime.mesh import batch_partition_spec
-
-        sharding = NamedSharding(mesh, batch_partition_spec())
-
-    q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
-    _SENTINEL = object()
-    errors: List[BaseException] = []
-
-    def put(batch):
-        if sharding is not None:
-            return {
-                k: jax.make_array_from_process_local_data(sharding, v)
-                for k, v in batch.items()
-            }
-        return jax.device_put(batch)
-
-    def worker():
-        try:
-            for batch in iterator:
-                q.put(put(batch))
-        except BaseException as e:  # propagate to consumer
-            errors.append(e)
-        finally:
-            q.put(_SENTINEL)
-
-    t = threading.Thread(target=worker, daemon=True)
-    t.start()
-    while True:
-        item = q.get()
-        if item is _SENTINEL:
-            if errors:
-                raise errors[0]
-            return
-        yield item
+from tpudl.data.prefetch import (  # noqa: E402,F401
+    DevicePrefetcher,
+    PrefetchAutotuner,
+    prefetch_to_device,
+)

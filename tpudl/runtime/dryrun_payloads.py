@@ -18,8 +18,9 @@ def converter_fed_train_smoke(data_dir: str, local_batch: int = 16):
     import jax.numpy as jnp
     import optax
 
-    from tpudl.data.converter import make_converter, prefetch_to_device
-    from tpudl.data.datasets import normalize_cifar_batch
+    from tpudl.data.converter import make_converter
+    from tpudl.data.datasets import device_normalize_cifar, wire_cifar_batch
+    from tpudl.data.prefetch import prefetch_to_device
     from tpudl.models.resnet import ResNetTiny
     from tpudl.runtime.mesh import MeshSpec, make_mesh
     from tpudl.train import (
@@ -35,20 +36,30 @@ def converter_fed_train_smoke(data_dir: str, local_batch: int = 16):
     state = create_train_state(
         jax.random.key(0), model, jnp.zeros((1, 32, 32, 3)), optax.sgd(0.05)
     )
-    step = compile_step(make_classification_train_step(), mesh, state, None)
+    # uint8 stays the wire dtype across the process/device boundary; the
+    # normalization runs INSIDE the jitted step (device-side
+    # preprocessing), and the prefetch pipeline is the two-stage one.
+    step = compile_step(
+        make_classification_train_step(
+            input_transform=device_normalize_cifar()
+        ),
+        mesh, state, None,
+    )
 
     batches = conv.make_batch_iterator(
         local_batch,
         epochs=1,
         shuffle=False,
         drop_last=True,
-        transform=normalize_cifar_batch,
     )
     losses = []
     state, metrics, info = fit(
         step,
         state,
-        prefetch_to_device(batches, mesh=mesh),
+        prefetch_to_device(
+            batches, mesh=mesh, transform=wire_cifar_batch,
+            assembly_workers=2,
+        ),
         jax.random.key(1),
         log_every=1,
         logger=lambda i, m: losses.append(m["loss"]),

@@ -359,6 +359,7 @@ def compile_step(
     rules: Optional[Rules] = None,
     donate_state: Optional[bool] = None,
     has_rng: bool = True,
+    preprocess: Optional[Callable[[dict], dict]] = None,
 ) -> Callable:
     """jit a (state, batch[, rng]) step with mesh shardings.
 
@@ -371,9 +372,30 @@ def compile_step(
     and return a new state) donate the old state's buffers; eval steps
     (``has_rng=False``, returning only metrics) must NOT donate or the
     caller's state would be destroyed on first use.
+
+    ``preprocess`` runs on the batch INSIDE the jitted program, before
+    ``step_fn`` sees it — the device-side preprocessing hook for ANY step
+    shape (e.g. ``tpudl.data.datasets.device_normalize_cifar``: uint8
+    pixels cross the host->device link at 1/4 the bytes, XLA fuses the
+    cast+scale into the first layer). It applies to the whole batch
+    before any gradient-accumulation split; a step built by
+    ``make_classification_train_step(input_transform=...)`` instead
+    applies per microbatch, which keeps the full batch in its compact
+    wire dtype under accumulation — prefer that for ``accum_steps > 1``.
     """
     if donate_state is None:
         donate_state = has_rng
+    if preprocess is not None:
+        base_fn = step_fn
+        if has_rng:
+            def step_fn(state, batch, rng, _base=base_fn):
+                return _base(state, preprocess(batch), rng)
+        else:
+            def step_fn(state, batch, _base=base_fn):
+                return _base(state, preprocess(batch))
+        step_fn._tpudl_mask_aware = getattr(
+            base_fn, "_tpudl_mask_aware", False
+        )
     state_sh = tree_shardings(mesh, state, rules)
     batch_sh = NamedSharding(mesh, batch_partition_spec())
     repl = NamedSharding(mesh, PartitionSpec())
