@@ -351,6 +351,16 @@ def _bench_input_pipeline():
     return legacy, pipelined
 
 
+def _bench_serve():
+    """Serving headline: continuous-batching tokens/sec, p99 TTFT, and
+    the speedup over run-to-completion static batching at equal slots
+    (benchmarks/serve_load.py — tiny-Llama engine, warmed up, ragged
+    request mix)."""
+    from benchmarks.serve_load import measure_serve
+
+    return measure_serve(n_requests=16, num_slots=4)
+
+
 def main():
     bert_sps, bert_mfu = _bench_bert()
     resnet_ips = _bench_resnet()
@@ -368,6 +378,15 @@ def main():
         print("input-pipeline bench failed:", file=sys.stderr)
         traceback.print_exc()
         pipe_legacy = pipe_new = None
+    try:
+        serve = _bench_serve()
+    except Exception:
+        import sys
+        import traceback
+
+        print("serve bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        serve = {}
 
     vs_baseline = (
         bert_sps / BASELINE_BERT_SAMPLES_PER_SEC
@@ -418,6 +437,15 @@ def main():
                 )
                 if pipe_new is not None and pipe_legacy
                 else None,
+                # Serving engine (tpudl.serve via benchmarks/
+                # serve_load.py): continuous-batching throughput, tail
+                # TTFT, and the continuous-vs-static speedup at equal
+                # slot count on the ragged request mix.
+                "serve_tokens_per_sec": serve.get("serve_tokens_per_sec"),
+                "serve_p99_ttft_ms": serve.get("serve_p99_ttft_ms"),
+                "serve_vs_static_batching": serve.get(
+                    "serve_vs_static_batching"
+                ),
             }
         )
     )

@@ -1,0 +1,280 @@
+"""Serving load generator: tokens/sec and tail latency under load.
+
+Two drive modes over a tpudl.serve.ServeSession:
+
+- **closed loop** (``run_closed_loop``): all requests submitted
+  up front, the engine drains them flat out — measures peak throughput
+  (tokens/sec) and the TTFT/TPOT distribution when queue wait is the
+  dominant cost.
+- **open loop** (``run_open_loop``): requests arrive on a Poisson-ish
+  schedule at an offered rate (req/s) while the engine steps; arrivals
+  the engine can't keep up with queue up, blow their deadlines, and
+  shed — measures the latency/shed curve vs offered load, the thing a
+  capacity plan reads.
+
+The headline comparison (``compare_continuous_vs_static``) runs the
+SAME ragged workload through the engine twice: continuous (slots refill
+mid-stream) vs static (``continuous=False`` — run-to-completion
+batches, the reference-style baseline). Two speedups are reported:
+``speedup_tokens_per_sec`` (wall clock, what you feel) and
+``speedup_steps`` (decode-step count, deterministic — the number the
+tier-1 test asserts, immune to host jitter).
+
+    python -m benchmarks.serve_load                # one JSON blob
+    python -m benchmarks.serve_load --rates 5 20 80  # + open-loop sweep
+
+bench.py records ``serve_tokens_per_sec`` / ``serve_p99_ttft_ms`` /
+``serve_vs_static_batching`` from ``measure_serve()`` each round.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# Workload shape: ragged max_new_tokens is WHY continuous batching wins
+# (a static batch waits for its longest row); the 4:1 long:short mix
+# mirrors the bimodal request lengths real serving sees.
+SHORT_TOKENS = 6
+LONG_TOKENS = 40
+PROMPT_LEN = 8
+MAX_SEQ_LEN = 256
+
+
+def build_session(
+    num_slots: int = 4,
+    continuous: bool = True,
+    max_seq_len: int = MAX_SEQ_LEN,
+    clock=time.perf_counter,
+):
+    """Tiny-Llama serving session (f32 so CPU runs are deterministic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudl.models.llama import LLAMA_TINY, LlamaForCausalLM
+    from tpudl.serve import ServeSession
+
+    cfg = LLAMA_TINY(dtype=jnp.float32, max_seq_len=max_seq_len)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, PROMPT_LEN), jnp.int32)
+    )["params"]
+    session = ServeSession.from_model(
+        model, params, prompt_len=PROMPT_LEN, num_slots=num_slots,
+        continuous=continuous, clock=clock,
+    )
+    return session, model, params
+
+
+def make_requests(
+    n: int,
+    seed: int = 0,
+    long_every: int = 4,
+    deadline_s: Optional[float] = None,
+    vocab_size: int = 512,
+) -> List:
+    """Ragged request mix: every ``long_every``-th request is long."""
+    from tpudl.serve import Request
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        prompt = rng.integers(
+            1, vocab_size, size=int(rng.integers(2, PROMPT_LEN + 1))
+        ).tolist()
+        out.append(
+            Request(
+                request_id=f"req{i}",
+                input_ids=prompt,
+                max_new_tokens=(
+                    LONG_TOKENS if i % long_every == 0 else SHORT_TOKENS
+                ),
+                deadline_s=deadline_s,
+            )
+        )
+    return out
+
+
+def _latency_stats(results: Dict) -> dict:
+    ok = [r for r in results.values() if r.ok]
+    shed = [r for r in results.values() if not r.ok]
+    ttfts = np.asarray([r.ttft_s for r in ok if r.ttft_s is not None])
+    tpots = np.asarray([r.tpot_s for r in ok if r.tpot_s is not None])
+
+    def pct(xs):
+        if xs.size == 0:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        return {
+            "p50_ms": round(1e3 * float(np.percentile(xs, 50)), 3),
+            "p95_ms": round(1e3 * float(np.percentile(xs, 95)), 3),
+            "p99_ms": round(1e3 * float(np.percentile(xs, 99)), 3),
+        }
+
+    return {
+        "completed": len(ok),
+        "shed": len(shed),
+        "tokens": int(sum(len(r.tokens) for r in ok)),
+        "ttft": pct(ttfts),
+        "tpot": pct(tpots),
+    }
+
+
+def warmup_session(session, seed: int = 9999) -> None:
+    """Drive every compiled path once (prefill, decode, both selection
+    shapes, insert/free, refill) so the timed window measures
+    steady-state serving, not first-call compilation — the latency
+    harness's warmup doctrine (tpudl.export.latency) applied to the
+    engine."""
+    n = session.num_slots + 1  # +1 forces one mid-stream refill
+    session.serve(make_requests(n, seed=seed, long_every=2))
+
+
+def run_closed_loop(
+    session, requests: Sequence, clock=time.perf_counter,
+    warmup: bool = True,
+) -> dict:
+    """Submit everything, drain, report throughput + tail latency."""
+    if warmup:
+        warmup_session(session)
+    steps0 = session.engine.num_decode_steps
+    rolls0 = session.engine.num_rollovers
+    t0 = clock()
+    results = session.serve(list(requests))
+    elapsed = clock() - t0
+    stats = _latency_stats(results)
+    stats.update(
+        mode="closed",
+        wall_s=round(elapsed, 4),
+        tokens_per_sec=round(stats["tokens"] / elapsed, 2),
+        decode_steps=session.engine.num_decode_steps - steps0,
+        rollovers=session.engine.num_rollovers - rolls0,
+    )
+    return stats
+
+
+def run_open_loop(
+    session,
+    requests: Sequence,
+    offered_rate: float,
+    seed: int = 0,
+    clock=time.perf_counter,
+) -> dict:
+    """Feed arrivals at ``offered_rate`` req/s (exponential gaps) while
+    stepping the engine; under overload the queue grows and deadlines
+    shed — exactly the regime the closed loop can't show."""
+    warmup_session(session)
+    steps0 = session.engine.num_decode_steps
+    rolls0 = session.engine.num_rollovers
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / offered_rate, size=len(requests))
+    arrivals = np.cumsum(gaps)
+    t0 = clock()
+    i = 0
+    while True:
+        now = clock() - t0
+        while i < len(requests) and arrivals[i] <= now:
+            session.submit(requests[i])
+            i += 1
+        progressed = session.engine.step()
+        if i >= len(requests) and not progressed:
+            break
+        if not progressed and i < len(requests):
+            # Engine idle before the next arrival: wait it out.
+            time.sleep(max(0.0, arrivals[i] - (clock() - t0)))
+    elapsed = clock() - t0
+    results = session.collect()
+    stats = _latency_stats(results)
+    stats.update(
+        mode="open",
+        offered_rate=offered_rate,
+        wall_s=round(elapsed, 4),
+        tokens_per_sec=round(stats["tokens"] / elapsed, 2),
+        decode_steps=session.engine.num_decode_steps - steps0,
+        rollovers=session.engine.num_rollovers - rolls0,
+    )
+    return stats
+
+
+def compare_continuous_vs_static(
+    n_requests: int = 16, num_slots: int = 4, seed: int = 0
+) -> dict:
+    """Same ragged workload, continuous vs run-to-completion static
+    batching, equal slot count — the acceptance comparison."""
+    cont_session, _, _ = build_session(num_slots, continuous=True)
+    cont = run_closed_loop(cont_session, make_requests(n_requests, seed))
+    stat_session, _, _ = build_session(num_slots, continuous=False)
+    stat = run_closed_loop(stat_session, make_requests(n_requests, seed))
+    return {
+        "num_slots": num_slots,
+        "n_requests": n_requests,
+        "continuous": cont,
+        "static": stat,
+        "speedup_tokens_per_sec": round(
+            cont["tokens_per_sec"] / stat["tokens_per_sec"], 3
+        ),
+        "speedup_steps": round(
+            stat["decode_steps"] / cont["decode_steps"], 3
+        ),
+    }
+
+
+def measure_serve(n_requests: int = 16, num_slots: int = 4) -> dict:
+    """The bench.py entry: headline serving numbers for one round."""
+    cmp = compare_continuous_vs_static(n_requests, num_slots)
+    return {
+        "serve_tokens_per_sec": cmp["continuous"]["tokens_per_sec"],
+        "serve_p99_ttft_ms": cmp["continuous"]["ttft"]["p99_ms"],
+        "serve_p99_tpot_ms": cmp["continuous"]["tpot"]["p99_ms"],
+        "serve_vs_static_batching": cmp["speedup_tokens_per_sec"],
+        "serve_vs_static_steps": cmp["speedup_steps"],
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        description="tpudl serving load benchmark: continuous vs static "
+        "batching, plus an open-loop offered-load sweep"
+    )
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--rates", type=float, nargs="*", default=[],
+        help="offered loads (req/s) for the open-loop sweep",
+    )
+    ap.add_argument(
+        "--deadline-s", type=float, default=None,
+        help="per-request deadline for the open-loop sweep (sheds under "
+        "overload)",
+    )
+    args = ap.parse_args(argv)
+
+    out = compare_continuous_vs_static(args.requests, args.slots, args.seed)
+    sweeps = []
+    for rate in args.rates:
+        session, _, _ = build_session(args.slots, continuous=True)
+        sweeps.append(
+            run_open_loop(
+                session,
+                make_requests(
+                    args.requests, args.seed, deadline_s=args.deadline_s
+                ),
+                offered_rate=rate,
+                seed=args.seed,
+            )
+        )
+    if sweeps:
+        out["open_loop_sweep"] = sweeps
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

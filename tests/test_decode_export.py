@@ -139,6 +139,79 @@ def test_exported_eos_padding():
     assert row[0] == eos and np.all(row == eos)  # padded after first eos
 
 
+def test_exported_early_exit_skips_decode_calls():
+    """Regression: the exported serving loop early-exits when every row
+    is done — a batch finishing at token 1 used to pay max_new_tokens-1
+    dead decode dispatches; now it pays zero and eos-pads the output."""
+    model, params, ids = _setup()
+    # Batch-1 artifacts so "every row done at token 1" is constructible
+    # (one row's first token IS the eos).
+    pre, dec = export_decoder(model, params, 1, S)
+    prefill_call, decode_call = load_decoder(pre, dec)
+
+    calls = []
+
+    def counting_decode(*args):
+        calls.append(1)
+        return decode_call(*args)
+
+    first = generate_with_exported(
+        prefill_call, decode_call, params, ids[0:1], max_new_tokens=1
+    )
+    eos_row0 = int(first[0, 0])
+    calls.clear()
+    got = generate_with_exported(
+        prefill_call, counting_decode, params, ids[0:1],
+        max_new_tokens=10, eos_id=eos_row0,
+    )
+    assert len(calls) == 0, (
+        f"all-done batch ran {len(calls)} dead decode calls"
+    )
+    row = np.asarray(got)[0]
+    assert row.shape == (10,) and np.all(row == eos_row0)
+
+    # A live row must NOT trigger the early exit: pick an eos the row
+    # does not emit in 6 tokens — every decode dispatch still happens.
+    calls.clear()
+    probe = np.asarray(
+        generate_with_exported(
+            prefill_call, decode_call, params, ids[0:1], max_new_tokens=6
+        )
+    )[0]
+    never_eos = int(
+        next(t for t in range(CFG.vocab_size) if t not in set(probe))
+    )
+    got2 = generate_with_exported(
+        prefill_call, counting_decode, params, ids[0:1],
+        max_new_tokens=6, eos_id=never_eos,
+    )
+    assert np.asarray(got2).shape == (1, 6)
+    assert len(calls) == 5  # max_new_tokens - 1, no dead skipping
+
+    # The readback is PACED: a mid-stream finish is only noticed at the
+    # next eos_check_every boundary (per-token host syncs would
+    # serialize the async dispatch pipeline), and the overshoot rows are
+    # eos anyway, so outputs are unchanged.
+    hit = 3
+    eos_mid = int(probe[hit])
+    hit = int(np.argmax(probe == eos_mid))  # first occurrence
+    calls.clear()
+    got3 = generate_with_exported(
+        prefill_call, counting_decode, params, ids[0:1],
+        max_new_tokens=12, eos_id=eos_mid, eos_check_every=1,
+    )
+    assert len(calls) == hit  # per-token checks: exit the step eos lands
+    row3 = np.asarray(got3)[0]
+    assert row3[hit] == eos_mid and np.all(row3[hit:] == eos_mid)
+    import pytest
+
+    with pytest.raises(ValueError, match="eos_check_every"):
+        generate_with_exported(
+            prefill_call, decode_call, params, ids[0:1],
+            max_new_tokens=2, eos_id=0, eos_check_every=0,
+        )
+
+
 def test_decode_latency_harness_runs():
     """The latency harness (warmup-excluded, transfer/compute split)
     accepts the exported decode step — the reference's latency loop
@@ -156,3 +229,11 @@ def test_decode_latency_harness_runs():
     )
     assert out["compute"]["mean_ms"] > 0
     assert out["transfer"]["mean_ms"] > 0
+    # Tail percentiles ride alongside the legacy keys (serving SLOs are
+    # quoted at p99), and the warmup count is part of the record.
+    for window in ("compute", "transfer"):
+        stats = out[window]
+        assert stats["p99_ms"] >= stats["p95_ms"] >= stats["p50_ms"]
+        assert stats["max_ms"] >= stats["p99_ms"]
+        assert stats["min_ms"] <= stats["p50_ms"]
+    assert out["warmup"] == 1

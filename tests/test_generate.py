@@ -240,3 +240,49 @@ def test_generate_rejects_zero_tokens(model_and_params):
     prompt = jnp.ones((B, S), jnp.int32)
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(model, params, prompt, max_new_tokens=0)
+
+
+def test_early_exit_skips_decode_chunks(model_and_params, monkeypatch):
+    """Regression: a batch whose every row is done must not pay dead
+    decode chunks — finishing at token 1 runs ZERO chunks, finishing
+    mid-stream skips every chunk after the one that completed it."""
+    import importlib
+
+    # tpudl.models re-exports the generate FUNCTION under the submodule's
+    # name, so attribute-style import resolves to the function.
+    gen_mod = importlib.import_module("tpudl.models.generate")
+
+    model, params = model_and_params
+    prompt = jax.random.randint(jax.random.key(40), (B, S), 1, CFG.vocab_size)
+    probe = generate(model, params, prompt, max_new_tokens=10)
+
+    calls = []
+    real = gen_mod._decode_chunk
+
+    def counting(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(gen_mod, "_decode_chunk", counting)
+
+    # Every row's FIRST token as its own eos is impossible batch-wide
+    # (rows differ), so drive a single row: done after token 1.
+    row = prompt[0:1]
+    eos_first = int(probe[0, 0])
+    got = generate(model, params, row, max_new_tokens=30, eos_id=eos_first,
+                   eos_check_every=4)
+    assert len(calls) == 0, "all-done batch still ran decode chunks"
+    np.testing.assert_array_equal(np.asarray(got[0]), eos_first)
+
+    # Mid-stream finish: eos at generated token 6 (0-indexed 5) with
+    # chunk length 4 -> exactly 2 chunks run, the other 6 skipped.
+    calls.clear()
+    eos_mid = int(probe[0, 5])
+    first_hit = int(np.argmax(np.asarray(probe[0]) == eos_mid))
+    generate(model, params, row, max_new_tokens=30, eos_id=eos_mid,
+             eos_check_every=4)
+    expected_chunks = -(-first_hit // 4)  # ceil((hit_idx) / chunk)
+    assert len(calls) == expected_chunks, (
+        f"expected {expected_chunks} chunks for eos at token index "
+        f"{first_hit}, ran {len(calls)} (early exit broken)"
+    )

@@ -29,6 +29,11 @@ A ground-up JAX/XLA/pjit/Pallas re-design of the capability surface of
   latency benchmarking — the reference's signature behavior
   (reference: notebooks/cv/onnx_experiments.py:81-144) rebuilt as a
   CPU-XLA vs TPU-XLA harness.
+- ``tpudl.serve``    — request-level inference engine: bounded admission
+  queue, fixed-slot KV cache manager, and continuous batching that
+  multiplexes many generation requests onto the two compiled decode-path
+  programs (live model or deserialized StableHLO artifact,
+  token-for-token interchangeable).
 
 See each subpackage's ``__init__`` for its current contents; subsystems land
 in the order of SURVEY.md §7.3.

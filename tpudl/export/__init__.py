@@ -13,6 +13,7 @@ from tpudl.export.export import (  # noqa: F401
     artifact_sizes,
     export_stablehlo,
     load_exported,
+    load_exported_obj,
     load_params,
     save_params,
 )

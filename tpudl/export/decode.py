@@ -46,22 +46,36 @@ def export_decoder(
     prompt_len: int,
     path_prefix: Optional[str] = None,
     platforms: Optional[Sequence[str]] = None,
+    decode_batch_size: Optional[int] = None,
 ) -> Tuple[bytes, bytes]:
     """Export (prefill, decode) StableHLO artifacts for fixed
     ``batch_size``/``prompt_len`` shapes (static shapes are the serving
     contract — the KV cache is bounded by model.cfg.max_seq_len).
 
+    ``decode_batch_size`` lets the decode program carry a different
+    batch than the prefill (the continuous-batching engine prefills one
+    request at a time into a slot-batched decode — see
+    ``export_serving_decoder``); default: same as ``batch_size``.
+
     With ``path_prefix``, writes ``{prefix}.prefill.stablehlo`` and
     ``{prefix}.decode.stablehlo``.
     """
+    if decode_batch_size is None:
+        decode_batch_size = batch_size
     ids = jnp.zeros((batch_size, prompt_len), jnp.int32)
     mask = jnp.ones((batch_size, prompt_len), jnp.int32)
     pf = prefill_fn(model)
-    # A real (abstractly-traced) cache example for the decode export.
-    _, cache = jax.eval_shape(pf, params, ids, mask)
+    # A real (abstractly-traced) cache example for the decode export, at
+    # the decode program's own batch.
+    _, cache = jax.eval_shape(
+        pf,
+        params,
+        jnp.zeros((decode_batch_size, prompt_len), jnp.int32),
+        jnp.ones((decode_batch_size, prompt_len), jnp.int32),
+    )
     cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache)
-    token = jnp.zeros((batch_size,), jnp.int32)
-    position = jnp.full((batch_size,), prompt_len, jnp.int32)
+    token = jnp.zeros((decode_batch_size,), jnp.int32)
+    position = jnp.full((decode_batch_size,), prompt_len, jnp.int32)
 
     prefill_blob = export_stablehlo(
         pf,
@@ -78,6 +92,26 @@ def export_decoder(
     return prefill_blob, decode_blob
 
 
+def export_serving_decoder(
+    model,
+    params,
+    num_slots: int,
+    prompt_len: int,
+    path_prefix: Optional[str] = None,
+    platforms: Optional[Sequence[str]] = None,
+) -> Tuple[bytes, bytes]:
+    """Export the artifact pair the continuous-batching engine serves
+    (tpudl.serve): a BATCH-1 prefill (requests are seated one at a
+    time) and a batch-``num_slots`` decode (all slots step together).
+    ``ServeSession.from_artifacts`` recovers every shape it needs from
+    these blobs — no side-channel metadata."""
+    return export_decoder(
+        model, params, 1, prompt_len,
+        path_prefix=path_prefix, platforms=platforms,
+        decode_batch_size=num_slots,
+    )
+
+
 def generate_with_exported(
     prefill_call: Callable,
     decode_call: Callable,
@@ -87,6 +121,7 @@ def generate_with_exported(
     max_new_tokens: int = 32,
     eos_id: Optional[int] = None,
     max_seq_len: Optional[int] = None,
+    eos_check_every: int = 8,
 ) -> jax.Array:
     """Greedy generation driven entirely by deserialized artifacts — the
     session.run loop of the reference, over StableHLO. Ragged prompt
@@ -100,8 +135,19 @@ def generate_with_exported(
     (model.cfg.max_seq_len) — the deserialized callables cannot see it,
     and overflowing it would silently CLAMP cache writes to the last slot
     (corrupted tokens, no error). Always pass it on serving paths.
+
+    ``eos_check_every`` paces the all-rows-done early-exit readback
+    (same contract as ``generate()``): the check is a blocking host
+    sync, so it runs after the first token (catching the
+    finished-at-token-1 batch for free) and then once per
+    ``eos_check_every`` tokens — NOT per token, which would serialize
+    the otherwise-async decode dispatches on relay-attached devices.
     """
     b, s = input_ids.shape
+    if eos_check_every < 1:
+        raise ValueError(
+            f"eos_check_every must be >= 1, got {eos_check_every}"
+        )
     if max_seq_len is not None and s + max_new_tokens > max_seq_len:
         raise ValueError(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
@@ -124,10 +170,27 @@ def generate_with_exported(
         tokens.append(token)
         if i + 1 == max_new_tokens:
             break
+        if (
+            eos_id is not None
+            and (i == 0 or (i + 1) % eos_check_every == 0)
+            and bool(done.all())
+        ):
+            # Every row finished: the remaining positions are eos by
+            # contract — emit them without paying a dead decode dispatch
+            # per token (a batch that finishes at token 1 used to scan
+            # all remaining steps; tests/test_decode_export.py asserts
+            # the decode-call count).
+            break
         logits, cache = decode_call(params, cache, token, position)
         position = position + 1
         token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jnp.stack(tokens, axis=1)
+    out = jnp.stack(tokens, axis=1)
+    if out.shape[1] < max_new_tokens:
+        pad = jnp.full(
+            (b, max_new_tokens - out.shape[1]), eos_id, out.dtype
+        )
+        out = jnp.concatenate([out, pad], axis=1)
+    return out
 
 
 def load_decoder(

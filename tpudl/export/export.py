@@ -42,23 +42,31 @@ def export_stablehlo(
     return blob
 
 
-def load_exported(blob_or_path: Union[bytes, str]) -> Callable:
-    """Deserialize a StableHLO artifact into a callable (the
-    InferenceSession analog, reference notebooks/cv/onnx_experiments.py:81)."""
+def load_exported_obj(blob_or_path: Union[bytes, str]) -> "jax_export.Exported":
+    """Deserialize a StableHLO artifact into the full Exported object —
+    callable via ``.call`` AND introspectable via ``.in_avals`` /
+    ``.in_tree`` (how a serving runtime recovers the compiled shapes —
+    slot count, prompt window, cache bound — from the artifact alone;
+    see tpudl.serve.api.ServeSession.from_artifacts)."""
     if isinstance(blob_or_path, str):
         with open(blob_or_path, "rb") as f:
             blob = f.read()
     else:
         blob = blob_or_path
     try:
-        exported = jax_export.deserialize(blob)
+        return jax_export.deserialize(blob)
     except Exception as e:
         source = blob_or_path if isinstance(blob_or_path, str) else "<bytes>"
         raise ValueError(
             f"{source} is not a valid serialized StableHLO artifact "
             f"(expected output of export_stablehlo): {type(e).__name__}: {e}"
         ) from e
-    return exported.call
+
+
+def load_exported(blob_or_path: Union[bytes, str]) -> Callable:
+    """Deserialize a StableHLO artifact into a callable (the
+    InferenceSession analog, reference notebooks/cv/onnx_experiments.py:81)."""
+    return load_exported_obj(blob_or_path).call
 
 
 def save_params(path: str, params: Any, overwrite: bool = True) -> None:

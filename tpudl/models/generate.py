@@ -123,7 +123,11 @@ def _decode_chunk(
     (cache, token, position, done, rng), toks = jax.lax.scan(
         body, (cache, token, position, done, rng), None, length=steps
     )
-    return cache, token, position, done, rng, toks  # toks: [steps, B]
+    # The all-rows-done scalar is computed IN-GRAPH so the chunk loop's
+    # early-exit readback costs zero extra dispatches (an eager
+    # done.all() per chunk paid a relay round-trip on remote-attached
+    # serving just to ask "may I stop").
+    return cache, token, position, done, rng, toks, jnp.all(done)
 
 
 _NEG_INF = -1e30
@@ -309,13 +313,16 @@ def generate(
     out = [token[:, None]]
     remaining = max_new_tokens - 1
     eos_op = jnp.int32(eos_id if eos_id is not None else 0)
+    all_done = eos_id is not None and bool(done.all())
     while remaining > 0:
-        if eos_id is not None and bool(done.all()):
-            # Every row finished: pad the rest with eos, skip dead steps.
+        if all_done:
+            # Every row finished: pad the rest with eos, skip dead steps
+            # (a batch that finishes at token 1 runs ZERO decode chunks —
+            # tests/test_generate.py counts the invocations).
             out.append(jnp.full((b, remaining), eos_id, token.dtype))
             break
         steps = min(eos_check_every, remaining)
-        cache, token, position, done, rng, toks = _decode_chunk(
+        cache, token, position, done, rng, toks, all_done_op = _decode_chunk(
             model, steps, greedy, top_k,
             top_p is not None, eos_id is not None,
             params, cache, token, position, done, rng,
@@ -323,4 +330,7 @@ def generate(
         )
         out.append(toks.T)
         remaining -= steps
+        # One readback of the chunk's in-graph all-done scalar — the
+        # same sync the chunked design already paid, no extra dispatch.
+        all_done = remaining > 0 and eos_id is not None and bool(all_done_op)
     return jnp.concatenate(out, axis=1)

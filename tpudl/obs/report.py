@@ -219,6 +219,21 @@ def format_report(report: dict) -> str:
         if cs:
             rendered = " ".join(f"{k}={v:g}" for k, v in sorted(cs.items()))
             lines.append(f"counters {key}: {rendered}")
+        gs = snap.get("gauges", {})
+        if gs:
+            rendered = " ".join(f"{k}={v:g}" for k, v in sorted(gs.items()))
+            lines.append(f"gauges {key}: {rendered}")
+        # Registry histograms (e.g. the serving engine's serve_ttft_ms /
+        # serve_tpot_ms / serve_queue_wait_ms) ride the same snapshot;
+        # quote the tail, which is what a serving SLO reads.
+        for name, h in sorted(snap.get("histograms", {}).items()):
+            if not h.get("count"):
+                continue
+            lines.append(
+                f"histogram {key}: {name} n={h['count']} "
+                f"mean={h['mean']:.3f} p50={h['p50']:.3f} "
+                f"p95={h['p95']:.3f} p99={h['p99']:.3f}"
+            )
     return "\n".join(lines)
 
 

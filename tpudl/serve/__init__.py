@@ -1,0 +1,22 @@
+"""L4+ request-level serving: continuous batching over the compiled
+decode path.
+
+The reference repo's substance is export -> session -> infer on single
+inputs (reference notebooks/cv/onnx_experiments.py); this package is
+what sits between that and "serve heavy traffic": a bounded admission
+queue (tpudl.serve.queue), a fixed-slot KV cache manager
+(tpudl.serve.cache), a continuous-batching engine multiplexing many
+requests onto the two compiled XLA programs (tpudl.serve.engine), and a
+synchronous Request/Result front end that serves either a live model or
+a deserialized StableHLO artifact (tpudl.serve.api).
+"""
+
+from tpudl.serve.api import (  # noqa: F401
+    Request,
+    Result,
+    ServeSession,
+    assert_serving_parity,
+)
+from tpudl.serve.cache import SlotCache  # noqa: F401
+from tpudl.serve.engine import Engine  # noqa: F401
+from tpudl.serve.queue import AdmissionQueue  # noqa: F401

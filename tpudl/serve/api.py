@@ -1,0 +1,318 @@
+"""Request-level serving API: ``Request`` in, ``Result`` out.
+
+The synchronous front end over tpudl.serve.engine:
+
+    session = ServeSession.from_model(model, params, prompt_len=64)
+    session.submit(Request("r0", prompt_ids, max_new_tokens=32))
+    results = session.collect()          # {"r0": Result(tokens=[...])}
+
+``from_artifacts`` builds the SAME session from serialized StableHLO
+blobs (tpudl.export.decode.export_serving_decoder) — a served artifact
+and the live model are interchangeable: every shape the engine needs
+(slot count, prompt length, cache bound) is recovered from the
+artifact's input avals, and greedy outputs are token-for-token
+identical to live ``generate()`` (tests/test_serve.py asserts it;
+``assert_serving_parity`` is the reusable check).
+
+Admission errors (prompt longer than the compiled prompt window, or
+prompt window + max_new_tokens overflowing the KV-cache bound) raise at
+``submit`` — a request that can NEVER be seated is a caller bug, not
+load. Overload is data, not an exception: a full queue or a missed
+deadline produces a ``Result`` with finish_reason ``shed_capacity`` /
+``shed_timeout``.
+
+Knobs: ``TPUDL_SERVE_SLOTS`` (default slot count for ``from_model``,
+artifact sessions carry theirs in the decode program's batch dim) and
+``TPUDL_SERVE_QUEUE_DEPTH`` (admission queue capacity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudl.obs import registry
+from tpudl.obs.spans import active_recorder
+from tpudl.serve.cache import SlotCache
+from tpudl.serve.queue import AdmissionQueue
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``seed`` drives the per-request sampling
+    stream (token t uses ``fold_in(key(seed), t)``), so a sampled
+    request reproduces its tokens regardless of batch composition;
+    ``temperature=0`` is greedy argmax, identical to ``generate()``.
+    ``deadline_s`` is relative seconds from submit — a request not
+    SEATED by then is shed (running requests are never aborted)."""
+
+    request_id: Any
+    input_ids: Sequence[int]
+    max_new_tokens: int = 32
+    eos_id: Optional[int] = None
+    temperature: float = 0.0
+    seed: int = 0
+    priority: int = 0
+    deadline_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Result:
+    """Outcome of one request. ``tokens`` are the generated ids,
+    INCLUDING the eos that ended generation (no padding — compare
+    against a ``generate()`` row by prefix). finish_reason:
+    ``eos`` | ``length`` | ``shed_timeout`` | ``shed_capacity``."""
+
+    request_id: Any
+    tokens: List[int]
+    finish_reason: str
+    ttft_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+    queue_wait_s: Optional[float] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.finish_reason in ("eos", "length")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+    if value < 1:
+        raise ValueError(f"{name} must be >= 1, got {value}")
+    return value
+
+
+class ServeSession:
+    """Synchronous submit()/collect() serving over the slot engine."""
+
+    def __init__(
+        self,
+        prefill_call: Callable,
+        decode_call: Callable,
+        params: Any,
+        cache_template: Any,
+        prompt_len: int,
+        queue_capacity: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+        continuous: bool = True,
+    ):
+        # Deferred import: engine imports Request/Result from this
+        # module.
+        from tpudl.serve.engine import Engine
+
+        cache = SlotCache(cache_template)
+        self.queue = AdmissionQueue(
+            capacity=queue_capacity
+            if queue_capacity is not None
+            else _env_int("TPUDL_SERVE_QUEUE_DEPTH", 256),
+            clock=clock,
+        )
+        self.engine = Engine(
+            prefill_call, decode_call, params, cache, self.queue,
+            prompt_len, clock=clock, continuous=continuous,
+        )
+        self._pending_ids: set = set()
+
+    # -- constructors --------------------------------------------------
+
+    @classmethod
+    def from_model(
+        cls,
+        model,
+        params,
+        prompt_len: int,
+        num_slots: Optional[int] = None,
+        **kwargs,
+    ) -> "ServeSession":
+        """Live-model session: jit the prefill/decode contracts (batch 1
+        and batch ``num_slots`` respectively) and derive the cache
+        template by abstract evaluation — nothing compiles until the
+        first request."""
+        from tpudl.models.generate import decode_fn, prefill_fn
+
+        num_slots = (
+            num_slots
+            if num_slots is not None
+            else _env_int("TPUDL_SERVE_SLOTS", 4)
+        )
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        pf = prefill_fn(model)
+        ids = jax.ShapeDtypeStruct((num_slots, prompt_len), jnp.int32)
+        _, cache_template = jax.eval_shape(pf, params, ids, ids)
+        return cls(
+            jax.jit(pf), jax.jit(decode_fn(model)), params,
+            cache_template, prompt_len, **kwargs,
+        )
+
+    @classmethod
+    def from_artifacts(
+        cls,
+        prefill_blob_or_path,
+        decode_blob_or_path,
+        params,
+        **kwargs,
+    ) -> "ServeSession":
+        """Artifact session: every engine shape is recovered from the
+        deserialized programs — slot count and cache bound from the
+        decode input avals, prompt window from the prefill's."""
+        from tpudl.export.export import load_exported_obj
+
+        pre = load_exported_obj(prefill_blob_or_path)
+        dec = load_exported_obj(decode_blob_or_path)
+        (pre_args, _) = jax.tree.unflatten(pre.in_tree, pre.in_avals)
+        (dec_args, _) = jax.tree.unflatten(dec.in_tree, dec.in_avals)
+        _, ids_aval, _ = pre_args
+        _, cache_template, token_aval, _ = dec_args
+        if ids_aval.shape[0] != 1:
+            raise ValueError(
+                f"serving prefill artifact must be batch-1 (one request "
+                f"seated at a time), got batch {ids_aval.shape[0]} — "
+                f"export with tpudl.export.decode.export_serving_decoder"
+            )
+        prompt_len = int(ids_aval.shape[1])
+        session = cls(
+            pre.call, dec.call, params, cache_template, prompt_len,
+            **kwargs,
+        )
+        if session.num_slots != int(token_aval.shape[0]):
+            raise ValueError(
+                "decode artifact's cache and token batch dims disagree"
+            )
+        return session
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def num_slots(self) -> int:
+        return self.engine.num_slots
+
+    @property
+    def prompt_len(self) -> int:
+        return self.engine.prompt_len
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.engine.max_seq_len
+
+    # -- the request lifecycle -----------------------------------------
+
+    def submit(self, request: Request) -> Any:
+        """Admit one request. Raises ValueError for requests that can
+        never be served at this session's compiled shapes; records a
+        ``shed_capacity`` Result when the queue is full. Returns the
+        request_id either way."""
+        rid = request.request_id
+        if rid in self._pending_ids or rid in self.engine.results:
+            raise ValueError(f"duplicate request_id {rid!r}")
+        n = len(request.input_ids)
+        if n < 1:
+            raise ValueError("input_ids must hold at least one token")
+        if n > self.prompt_len:
+            raise ValueError(
+                f"prompt length {n} exceeds the session's compiled "
+                f"prompt window {self.prompt_len} (rejected at admission)"
+            )
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {request.max_new_tokens}"
+            )
+        if self.prompt_len + request.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt window ({self.prompt_len}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_seq_len "
+                f"{self.max_seq_len} (the KV-cache bound) — rejected at "
+                f"admission"
+            )
+        if request.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {request.temperature}"
+            )
+        if not 0 <= request.seed < 2**32:
+            # The engine carries seeds as uint32; an out-of-range seed
+            # would raise mid-serving (stranding every in-flight
+            # request) instead of here at admission.
+            raise ValueError(
+                f"seed must fit uint32 [0, 2**32), got {request.seed}"
+            )
+        self._pending_ids.add(rid)
+        admitted = self.queue.push(
+            request, priority=request.priority, deadline_s=request.deadline_s
+        )
+        if not admitted:
+            self.engine.results[rid] = Result(
+                request_id=rid, tokens=[], finish_reason="shed_capacity",
+                queue_wait_s=0.0,
+            )
+            registry().counter("serve_requests_shed_capacity").inc()
+        return rid
+
+    def collect(self) -> Dict[Any, Result]:
+        """Run the engine until every submitted request has a Result,
+        then hand them over (and flush a counters snapshot onto the
+        active obs stream, if recording)."""
+        self.engine.run_until_drained()
+        out = {
+            rid: self.engine.results.pop(rid) for rid in self._pending_ids
+        }
+        self._pending_ids.clear()
+        rec = active_recorder()
+        if rec is not None:
+            rec.counters(registry().snapshot())
+        return out
+
+    def serve(self, requests: Sequence[Request]) -> Dict[Any, Result]:
+        """submit() them all, collect() once — the closed-loop shape."""
+        for request in requests:
+            self.submit(request)
+        return self.collect()
+
+
+def assert_serving_parity(
+    session: ServeSession,
+    model,
+    params,
+    requests: Sequence[Request],
+) -> None:
+    """Assert every GREEDY request's engine tokens match live
+    ``generate()`` run on the request alone — the artifact-vs-live
+    interchangeability check (a Result's tokens are the generate row up
+    to and including eos; generate pads with eos after)."""
+    from tpudl.models.generate import generate
+
+    results = session.serve(list(requests))
+    for req in requests:
+        if req.temperature != 0.0:
+            continue
+        res = results[req.request_id]
+        assert res.ok, (req.request_id, res.finish_reason)
+        want = np.asarray(
+            generate(
+                model, params,
+                jnp.asarray(req.input_ids, jnp.int32)[None, :],
+                max_new_tokens=req.max_new_tokens,
+                eos_id=req.eos_id,
+            )
+        )[0]
+        got = np.asarray(res.tokens)
+        np.testing.assert_array_equal(
+            got, want[: got.shape[0]],
+            err_msg=f"request {req.request_id} diverged from generate()",
+        )
+        if req.eos_id is not None and got.shape[0] < want.shape[0]:
+            assert np.all(want[got.shape[0]:] == req.eos_id), (
+                f"request {req.request_id}: engine stopped at eos but "
+                f"generate() kept producing non-eos tokens"
+            )

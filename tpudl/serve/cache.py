@@ -1,0 +1,184 @@
+"""KV-slot manager: the static-shape cache pytree behind the engine.
+
+The engine's decode program is compiled ONCE for a fixed-slot cache
+(``[num_slots, max_seq_len, ...]`` per layer, the shape
+tpudl.models.llama.LlamaAttention builds in decode mode). Continuous
+batching never reshapes it — requests come and go by mutating WHICH
+rows mean something:
+
+- ``insert(row_cache, slot)`` scatters a batch-1 prefill's cache row
+  into an occupied batch (k/v/valid rows replaced wholesale, so the
+  slot's previous tenant vanishes atomically);
+- ``free(slot)`` zeroes the slot's validity row (its k/v bytes remain
+  but are unreachable — the attention mask is ``slot-order causal AND
+  valid``, the contract that makes a stale row harmless);
+- ``reset()`` returns the whole pytree to zeros, restoring the full
+  write horizon (the engine's rollover when the shared write index
+  nears ``max_seq_len``).
+
+Why insertion into an OCCUPIED cache is sound: LlamaAttention masks by
+slot write-order and validity, never by position (positions only drive
+RoPE phases, and those are baked into the cached keys at prefill). A
+new request's prompt lives at slots ``[0, prompt_len)`` — always below
+the shared write index — with everything above invalid, so the next
+decode query sees exactly its own prompt and nothing of the previous
+tenant. Neighbor rows are untouched: every per-row op in the model is
+batch-independent, so a refill is bit-invisible to the other slots
+(asserted by tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_valid_leaf(leaf) -> bool:
+    """The per-slot validity buffer: [num_slots, max_seq_len] bool."""
+    return leaf.ndim == 2 and leaf.dtype == jnp.bool_
+
+
+@jax.jit
+def _insert_row(cache, row_cache, slot):
+    """Scatter a batch-1 cache row into ``slot`` of the batch cache.
+
+    Scalar leaves (the shared write index) keep the BATCH cache's value
+    — the row cache's index is its own prompt length and must not
+    rewind the live batch. ``slot`` is traced, so one compiled program
+    serves every slot.
+    """
+
+    def one(c, r):
+        if c.ndim == 0:
+            return c
+        return jax.lax.dynamic_update_slice(
+            c, r.astype(c.dtype), (slot,) + (0,) * (c.ndim - 1)
+        )
+
+    return jax.tree.map(one, cache, row_cache)
+
+
+@jax.jit
+def _free_slot(cache, slot):
+    """Invalidate one slot: its validity row goes all-False. k/v bytes
+    stay (masked — see module docstring); scalar index leaves stay."""
+
+    def one(c):
+        if _is_valid_leaf(c):
+            row = jnp.zeros((1, c.shape[1]), c.dtype)
+            return jax.lax.dynamic_update_slice(c, row, (slot, 0))
+        return c
+
+    return jax.tree.map(one, cache)
+
+
+class SlotCache:
+    """Owns the engine's cache pytree and the slot bookkeeping on it.
+
+    ``template`` is a cache pytree of arrays or ShapeDtypeStructs with
+    leading dim ``num_slots`` (from ``jax.eval_shape`` of the prefill
+    contract at the slot-batched shape, or from a deserialized decode
+    artifact's input avals). The concrete cache starts zeroed —
+    all-invalid, which decode tolerates (an all-masked row softmaxes to
+    uniform weights over finite mask values; its output is discarded).
+    """
+
+    def __init__(self, template: Any):
+        self.cache = jax.tree.map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), template
+        )
+        valid_leaves = [
+            leaf for leaf in jax.tree.leaves(self.cache) if _is_valid_leaf(leaf)
+        ]
+        if not valid_leaves:
+            raise ValueError(
+                "cache template has no [num_slots, max_seq_len] bool "
+                "validity leaf — not a tpudl decode cache (expected the "
+                "pytree prefill_fn returns)"
+            )
+        self.num_slots = int(valid_leaves[0].shape[0])
+        self.max_seq_len = int(valid_leaves[0].shape[1])
+        self._write_index = 0
+
+    # -- slot mutation -------------------------------------------------
+
+    def insert(self, row_cache: Any, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
+        self.cache = _insert_row(self.cache, row_cache, jnp.int32(slot))
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
+        self.cache = _free_slot(self.cache, jnp.int32(slot))
+
+    def reset(self) -> None:
+        """All slots empty, write index 0: the full horizon is back."""
+        self.cache = jax.tree.map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), self.cache
+        )
+        self._write_index = 0
+
+    # -- the shared write index ----------------------------------------
+
+    @property
+    def write_index(self) -> int:
+        """The decode programs' next write slot (shared across rows —
+        every decode step writes all rows at this index and advances it
+        by one; see LlamaAttention's scalar cache index).
+
+        This is a HOST MIRROR of the device-side scalar, maintained by
+        ``reset``/``set_write_index``/``advance_write_index`` — the
+        value is fully host-determined, so the engine's per-step horizon
+        checks never pay a device readback (the relay round-trip this
+        repo's decode paths are designed around). It is correct as long
+        as every decode dispatch on ``self.cache`` is followed by one
+        ``advance_write_index()``, which Engine._decode_step does."""
+        return self._write_index
+
+    def set_write_index(self, index: int) -> None:
+        """Pin every layer's scalar write index (after filling a fresh
+        cache from batch-1 prefills, whose own indices were discarded by
+        ``insert``)."""
+        self.cache = jax.tree.map(
+            lambda leaf: jnp.asarray(index, leaf.dtype)
+            if leaf.ndim == 0
+            else leaf,
+            self.cache,
+        )
+        self._write_index = int(index)
+
+    def advance_write_index(self, steps: int = 1) -> None:
+        """Advance the host mirror after ``steps`` decode dispatches
+        (the device-side scalar advanced itself inside the program)."""
+        self._write_index += steps
+
+    @property
+    def remaining_horizon(self) -> int:
+        """Decode steps left before the cache is full. The engine
+        admits a request into a slot only if its max_new_tokens fits —
+        running past the horizon would silently CLAMP cache writes onto
+        the last slot (corrupted tokens, no error)."""
+        return self.max_seq_len - self.write_index
+
+    # -- accounting ----------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        """Resident bytes of the cache pytree (the number behind the
+        ``serve_cache_bytes`` gauge)."""
+        return int(
+            sum(leaf.nbytes for leaf in jax.tree.leaves(self.cache))
+        )
+
+    def valid_counts(self):
+        """Per-slot count of valid (attendable) cache positions — one
+        host readback of a [num_slots] reduction."""
+        for leaf in jax.tree.leaves(self.cache):
+            if _is_valid_leaf(leaf):
+                import numpy as np
+
+                return np.asarray(jnp.sum(leaf, axis=-1))
+        raise AssertionError("unreachable: ctor checked a valid leaf")

@@ -1,0 +1,346 @@
+"""Slot-based continuous batching over the two compiled decode programs.
+
+The whole engine is host orchestration around exactly two XLA
+executables — the batch-1 prefill and the slot-batched single-token
+decode that tpudl.models.generate defines and tpudl.export.decode
+serializes (``(params, ids, mask) -> (logits, cache)`` and
+``(params, cache, token, position) -> (logits, cache)``). Requests are
+multiplexed onto them through a fixed-slot cache:
+
+    queue ──pop──▶ prefill(batch=1) ──insert──▶ slot i of the cache
+                                                    │
+                 every step: decode(batch=slots) ───┘  finished slot →
+                 emit per-slot token, advance         Result out,
+                 per-slot position                    refill from queue
+
+A slot that finishes (eos / max tokens) is refilled IMMEDIATELY —
+mid-stream, while its neighbors keep decoding — which is the whole
+trick: a ragged batch never waits for its longest row
+(``continuous=False`` disables exactly this refill, turning the same
+engine into the run-to-completion static-batch baseline the load
+benchmark compares against).
+
+Why mid-stream insertion is correct: see tpudl.serve.cache (slot-order
++ validity masking makes the new row see only its own prompt, and every
+per-row op is batch-independent, so neighbors are bit-unaffected).
+
+The one resource all slots share is the cache WRITE INDEX: the compiled
+decode writes every row at the same slot and advances it by one per
+step (LlamaAttention's scalar index), so the horizon ``max_seq_len -
+write_index`` shrinks monotonically for everyone. The engine therefore
+(a) only seats a request whose max_new_tokens fits the remaining
+horizon, and (b) when the batch drains with work still queued, RESETS
+the cache to recover the full horizon (a "rollover" — the paged-KV
+successor removes this cost by recycling slots piecewise).
+
+Sampling is per-request and batch-composition-independent: token ``t``
+of a request is drawn with ``fold_in(key(request.seed), t)``, so the
+same request yields the same tokens whatever its neighbors are — a
+reproducibility property the batched ``generate()`` rng stream does not
+have (greedy requests match ``generate()`` token for token; sampled
+ones match themselves across engine runs and artifact/live backends).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudl.obs import registry
+from tpudl.obs.spans import active_recorder
+from tpudl.serve.api import Request, Result
+from tpudl.serve.cache import SlotCache
+from tpudl.serve.queue import AdmissionQueue, _Entry
+
+#: Span categories (their own rows in the obs report breakdown table).
+CAT_SERVE_PREFILL = "serve_prefill"
+CAT_SERVE_DECODE = "serve_decode"
+
+
+@jax.jit
+def _select_greedy(logits):
+    """Argmax-only selection: the fast path when no active slot samples
+    (temperature 0 is the default) — skips the per-slot key derivation
+    and the O(slots x vocab) categorical draw `_select_tokens` would
+    compute just to discard. Same f32 argmax, bit-identical tokens."""
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+
+@jax.jit
+def _select_tokens(logits, temps, seeds, steps):
+    """Per-slot next-token selection on [B, V] logits: greedy argmax
+    where ``temps[i] == 0``, else categorical over temperature-scaled
+    logits keyed by ``fold_in(key(seeds[i]), steps[i])`` — the stream
+    that makes sampling per-request deterministic regardless of which
+    slot or neighbors the request has. f32 selection math like
+    tpudl.models.generate._select_impl."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    keys = jax.vmap(
+        lambda s, t: jax.random.fold_in(jax.random.key(s), t)
+    )(seeds, steps)
+    scaled = logits / jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+class _Slot:
+    """Host-side state of one occupied decode slot."""
+
+    __slots__ = (
+        "entry", "request", "tokens", "position", "steps",
+        "t_seated", "t_first", "t_last",
+    )
+
+    def __init__(self, entry: _Entry, first_token: int, prompt_len: int,
+                 seated: float, now: float):
+        self.entry = entry
+        self.request: Request = entry.request
+        self.tokens: List[int] = [first_token]
+        self.position = prompt_len  # next absolute RoPE position
+        self.steps = 1  # tokens drawn so far (the sampling fold_in index)
+        self.t_seated = seated  # pop time: queue wait ends HERE
+        self.t_first = now  # first token out: TTFT ends here (incl. prefill)
+        self.t_last = now
+
+
+class Engine:
+    """The request multiplexer. Pulls from an AdmissionQueue, keeps
+    ``num_slots`` generation streams in flight, writes ``Result``s into
+    ``self.results`` keyed by request_id. Synchronous: ``step()``
+    advances the world by one decode step; ``run_until_drained()`` loops
+    it (the ServeSession front end drives either)."""
+
+    def __init__(
+        self,
+        prefill_call: Callable,
+        decode_call: Callable,
+        params: Any,
+        cache: SlotCache,
+        queue: AdmissionQueue,
+        prompt_len: int,
+        clock: Callable[[], float] = time.monotonic,
+        continuous: bool = True,
+    ):
+        if prompt_len < 1 or prompt_len >= cache.max_seq_len:
+            raise ValueError(
+                f"prompt_len must be in [1, max_seq_len) = "
+                f"[1, {cache.max_seq_len}), got {prompt_len}"
+            )
+        self.prefill_call = prefill_call
+        self.decode_call = decode_call
+        self.params = params
+        self.cache = cache
+        self.queue = queue
+        self.prompt_len = prompt_len
+        self.num_slots = cache.num_slots
+        self.max_seq_len = cache.max_seq_len
+        self.clock = clock
+        self.continuous = continuous
+        self._slots: List[Optional[_Slot]] = [None] * self.num_slots
+        self.results: Dict[Any, Result] = {}
+        # Stat counters (also mirrored into the obs registry): decode
+        # steps are the deterministic cost unit the static-vs-continuous
+        # comparison uses (wall time rides on them 1:1 at fixed slots).
+        self.num_decode_steps = 0
+        self.num_prefills = 0
+        self.num_rollovers = 0
+        # Static shapes: the cache's resident bytes never change after
+        # construction — publish once, not per step.
+        registry().gauge("serve_cache_bytes").set(cache.nbytes)
+
+    # -- admission / seating -------------------------------------------
+
+    def _record_shed(self, entries: List[_Entry], reason: str) -> None:
+        reg = registry()
+        now = self.clock()
+        for entry in entries:
+            req = entry.request
+            self.results[req.request_id] = Result(
+                request_id=req.request_id,
+                tokens=[],
+                finish_reason=reason,
+                queue_wait_s=now - entry.submitted_at,
+            )
+            reg.counter(f"serve_requests_{reason}").inc()
+
+    def _seat(self, entry: _Entry, slot: int) -> None:
+        """Prefill one request (batch-1 program) and scatter it into
+        ``slot`` of the live cache; select its first token."""
+        req = entry.request
+        ids = np.asarray(req.input_ids, np.int32)
+        pad = self.prompt_len - ids.shape[0]
+        padded = np.concatenate([np.zeros(pad, np.int32), ids])[None, :]
+        mask = np.concatenate(
+            [np.zeros(pad, np.int32), np.ones(ids.shape[0], np.int32)]
+        )[None, :]
+        rec = active_recorder()
+        t0 = self.clock()
+        logits, row_cache = self.prefill_call(self.params, padded, mask)
+        if req.temperature > 0:
+            sel = _select_tokens(
+                logits,
+                np.float32([req.temperature]),
+                np.uint32([req.seed]),
+                np.int32([0]),
+            )
+        else:
+            sel = _select_greedy(logits)
+        first = int(np.asarray(sel)[0])
+        self.cache.insert(row_cache, slot)
+        now = self.clock()
+        if rec is not None:
+            rec.record("prefill", CAT_SERVE_PREFILL, t0, now - t0,
+                       {"slot": slot})
+        self.num_prefills += 1
+        reg = registry()
+        reg.counter("serve_prefills").inc()
+        reg.histogram("serve_queue_wait_ms").observe(
+            1e3 * (t0 - entry.submitted_at)
+        )
+        reg.histogram("serve_ttft_ms").observe(1e3 * (now - entry.submitted_at))
+        self._slots[slot] = _Slot(entry, first, ids.shape[0], t0, now)
+        # A request can finish on its very first token.
+        self._maybe_finish(slot, first)
+
+    def _active(self) -> bool:
+        return any(s is not None for s in self._slots)
+
+    def _fill_slots(self) -> None:
+        """Seat queued work into empty slots. Static mode only refills
+        once the WHOLE batch drained (the run-to-completion baseline);
+        continuous mode refills the moment a slot frees."""
+        if not self.continuous and self._active():
+            return
+        if not self._active() and len(self.queue):
+            # Batch drained with work queued: recover the full write
+            # horizon before seating the next wave.
+            if self.cache.write_index > self.prompt_len:
+                self.cache.reset()
+                self.num_rollovers += 1
+                registry().counter("serve_rollovers").inc()
+        while True:
+            slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if slot is None:
+                break
+            base = max(self.cache.write_index, self.prompt_len)
+            entry, shed = self.queue.pop(
+                fit=lambda r: base + r.max_new_tokens <= self.max_seq_len
+            )
+            self._record_shed(shed, "shed_timeout")
+            if entry is None:
+                break
+            self._seat(entry, slot)
+        if self._active() and self.cache.write_index < self.prompt_len:
+            # Fresh cache just seated its first wave: the batch-1 row
+            # caches carried their own write indices (discarded by
+            # insert); pin the shared index past the prompt region.
+            self.cache.set_write_index(self.prompt_len)
+        registry().gauge("serve_slots_busy").set(
+            sum(s is not None for s in self._slots)
+        )
+
+    # -- stepping ------------------------------------------------------
+
+    def _maybe_finish(self, slot: int, token: int) -> None:
+        s = self._slots[slot]
+        req = s.request
+        if req.eos_id is not None and token == req.eos_id:
+            self._finish(slot, "eos")
+        elif len(s.tokens) >= req.max_new_tokens:
+            self._finish(slot, "length")
+
+    def _finish(self, slot: int, reason: str) -> None:
+        s = self._slots[slot]
+        req = s.request
+        n = len(s.tokens)
+        tpot = (s.t_last - s.t_first) / (n - 1) if n > 1 else None
+        self.results[req.request_id] = Result(
+            request_id=req.request_id,
+            tokens=list(s.tokens),
+            finish_reason=reason,
+            ttft_s=s.t_first - s.entry.submitted_at,
+            tpot_s=tpot,
+            # Queue wait ends at SEATING (pop), not first token — TTFT
+            # additionally carries the prefill (and, for the session's
+            # first request, compilation); matches serve_queue_wait_ms.
+            queue_wait_s=s.t_seated - s.entry.submitted_at,
+        )
+        reg = registry()
+        reg.counter("serve_requests_completed").inc()
+        reg.counter("serve_tokens_generated").inc(n)
+        if tpot is not None:
+            reg.histogram("serve_tpot_ms").observe(1e3 * tpot)
+        self.cache.free(slot)
+        self._slots[slot] = None
+
+    def _decode_step(self) -> None:
+        """One slot-batched decode dispatch + selection + host readback;
+        idle slots ride along with zeros and their output is discarded."""
+        assert self.cache.write_index < self.max_seq_len, (
+            "decode past the cache horizon would silently clamp writes "
+            "(admission fit checks should make this unreachable)"
+        )
+        b = self.num_slots
+        tokens = np.zeros(b, np.int32)
+        positions = np.zeros(b, np.int32)
+        temps = np.zeros(b, np.float32)
+        seeds = np.zeros(b, np.uint32)
+        steps = np.zeros(b, np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tokens[i] = s.tokens[-1]
+            positions[i] = s.position
+            temps[i] = s.request.temperature
+            seeds[i] = s.request.seed
+            steps[i] = s.steps
+        rec = active_recorder()
+        t0 = self.clock()
+        logits, self.cache.cache = self.decode_call(
+            self.params, self.cache.cache, tokens, positions
+        )
+        if temps.any():
+            sel = np.asarray(_select_tokens(logits, temps, seeds, steps))
+        else:
+            sel = np.asarray(_select_greedy(logits))
+        self.cache.advance_write_index()  # host mirror of the +1 in-graph
+        now = self.clock()
+        if rec is not None:
+            rec.record("decode_step", CAT_SERVE_DECODE, t0, now - t0,
+                       {"busy": int(sum(s is not None for s in self._slots))})
+        self.num_decode_steps += 1
+        registry().counter("serve_decode_steps").inc()
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            s.position += 1
+            s.steps += 1
+            s.t_last = now
+            tok = int(sel[i])
+            s.tokens.append(tok)
+            self._maybe_finish(i, tok)
+
+    def step(self) -> bool:
+        """Seat what fits, run one decode step. False when fully
+        drained (no active slots and nothing seatable queued)."""
+        self._fill_slots()
+        if not self._active():
+            # Nothing seated: the queue is empty or held only expired
+            # entries (shed during the fill's pop).
+            self._record_shed(self.queue.drain_expired(), "shed_timeout")
+            return False
+        self._decode_step()
+        return True
+
+    def run_until_drained(self) -> Dict[Any, Result]:
+        while self.step():
+            pass
+        registry().gauge("serve_slots_busy").set(0)
+        return self.results

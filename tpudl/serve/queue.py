@@ -1,0 +1,137 @@
+"""Bounded admission queue: what waits, in what order, and what gets shed.
+
+Scheduling policy, in order:
+
+- **priority, then FIFO**: entries pop lowest ``priority`` first and
+  submission order within a priority level (heap keyed on
+  ``(priority, seq)`` — the seq number makes equal-priority ordering
+  total and stable).
+- **deadlines shed at pop time**: a request whose absolute deadline has
+  passed when the engine asks for work is handed back as shed, not
+  served — the engine records it as a ``shed_timeout`` Result. Checking
+  at pop (not with a timer thread) keeps the queue stdlib-simple and is
+  exact where it matters: a request is never *started* past its
+  deadline.
+- **bounded depth sheds at push**: ``push`` on a full queue returns
+  False (``shed_capacity``); the caller decides whether that's an error
+  or load-shedding telemetry (ServeSession records a Result, the
+  open-loop load generator counts it as overload).
+- **fit-filtered pop**: the engine passes ``fit`` — "does this request's
+  max_new_tokens fit the cache horizon left" — and the queue serves the
+  best-priority request that fits, letting small requests overtake one
+  that must wait for a horizon rollover (bounded head-of-line blocking,
+  the same reason continuous batching exists at all).
+
+The clock is injectable (monotonic seconds) so deadline behavior is
+testable without sleeping.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+
+@dataclass(order=True)
+class _Entry:
+    priority: int
+    seq: int
+    request: Any = field(compare=False)
+    deadline: Optional[float] = field(compare=False)  # absolute clock time
+    submitted_at: float = field(compare=False)
+
+
+class AdmissionQueue:
+    """Priority+FIFO bounded queue with pop-time deadline shedding."""
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.clock = clock
+        self._heap: List[_Entry] = []
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    @property
+    def full(self) -> bool:
+        return len(self._heap) >= self.capacity
+
+    def push(
+        self,
+        request: Any,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> bool:
+        """Enqueue; False when the queue is at capacity (the caller
+        sheds). ``deadline_s`` is relative seconds from now — converted
+        to an absolute clock deadline here, so time spent queued counts
+        against it."""
+        if self.full:
+            return False
+        now = self.clock()
+        heapq.heappush(
+            self._heap,
+            _Entry(
+                priority=priority,
+                seq=next(self._seq),
+                request=request,
+                deadline=None if deadline_s is None else now + deadline_s,
+                submitted_at=now,
+            ),
+        )
+        return True
+
+    def pop(
+        self,
+        fit: Optional[Callable[[Any], bool]] = None,
+    ) -> Tuple[Optional[_Entry], List[_Entry]]:
+        """Best entry that is neither expired nor unfitting, plus every
+        entry shed on the way (deadline passed before scheduling).
+
+        Entries that are alive but fail ``fit`` are put back untouched —
+        they keep their priority and seq, so the FIFO-within-priority
+        order is preserved across a skipped pop."""
+        now = self.clock()
+        shed: List[_Entry] = []
+        skipped: List[_Entry] = []
+        picked: Optional[_Entry] = None
+        while self._heap:
+            entry = heapq.heappop(self._heap)
+            if entry.deadline is not None and now > entry.deadline:
+                shed.append(entry)
+                continue
+            if fit is not None and not fit(entry.request):
+                skipped.append(entry)
+                continue
+            picked = entry
+            break
+        for entry in skipped:
+            heapq.heappush(self._heap, entry)
+        return picked, shed
+
+    def drain_expired(self) -> List[_Entry]:
+        """Shed every expired entry without popping work (the engine's
+        idle housekeeping so deadline misses surface even when no slot
+        frees up)."""
+        now = self.clock()
+        alive: List[_Entry] = []
+        shed: List[_Entry] = []
+        for entry in self._heap:
+            if entry.deadline is not None and now > entry.deadline:
+                shed.append(entry)
+            else:
+                alive.append(entry)
+        if shed:
+            heapq.heapify(alive)
+            self._heap = alive
+        return shed
