@@ -361,6 +361,16 @@ def _bench_serve():
     return measure_serve(n_requests=16, num_slots=4)
 
 
+def _bench_ft():
+    """Fault-tolerance costs (benchmarks/ft_recovery.py): the async
+    checkpoint's on-step stall and the kill-to-first-post-restart-step
+    recovery time — the two numbers a preemptible-capacity run budget
+    is built from."""
+    from benchmarks.ft_recovery import measure_ft
+
+    return measure_ft()
+
+
 def main():
     bert_sps, bert_mfu = _bench_bert()
     resnet_ips = _bench_resnet()
@@ -387,6 +397,15 @@ def main():
         print("serve bench failed:", file=sys.stderr)
         traceback.print_exc()
         serve = {}
+    try:
+        ft = _bench_ft()
+    except Exception:
+        import sys
+        import traceback
+
+        print("fault-tolerance bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        ft = {}
 
     vs_baseline = (
         bert_sps / BASELINE_BERT_SAMPLES_PER_SEC
@@ -446,6 +465,24 @@ def main():
                 "serve_vs_static_batching": serve.get(
                     "serve_vs_static_batching"
                 ),
+                # Fault tolerance (tpudl.ft via benchmarks/
+                # ft_recovery.py): the async checkpoint's mean on-step
+                # stall (vs the synchronous save of the same payload)
+                # and the kill-to-first-post-restart-step recovery
+                # time.
+                "checkpoint_step_stall_ms": round(
+                    ft["checkpoint_step_stall_ms"], 2
+                )
+                if "checkpoint_step_stall_ms" in ft
+                else None,
+                "checkpoint_sync_save_ms": round(
+                    ft["checkpoint_sync_save_ms"], 2
+                )
+                if "checkpoint_sync_save_ms" in ft
+                else None,
+                "recovery_time_sec": round(ft["recovery_time_sec"], 3)
+                if "recovery_time_sec" in ft
+                else None,
             }
         )
     )

@@ -1,0 +1,115 @@
+"""Module-level payloads for the fault-tolerance spawn tests (picklable
+by reference from TpuDistributor worker subprocesses)."""
+
+
+def _ft_state():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpudl.models.resnet import ResNetTiny
+    from tpudl.train import create_train_state
+
+    model = ResNetTiny(num_classes=4)
+    return create_train_state(
+        jax.random.key(0), model, jnp.zeros((1, 16, 16, 3)),
+        optax.sgd(0.05, momentum=0.9),
+    )
+
+
+def _ft_batches(n):
+    """Seeded per-host batch stream: every process regenerates the same
+    local shards, so the global schedule is reproducible across
+    restarts and across the control run."""
+    from tpudl.data.synthetic import synthetic_classification_batches
+
+    return list(
+        synthetic_classification_batches(
+            16, image_shape=(16, 16, 3), num_classes=4, num_batches=n,
+            seed=7,
+        )
+    )
+
+
+def elastic_train(ckpt_dir, total_steps=8, ckpt_every=2):
+    """The resume-idempotent supervised payload: resume from the newest
+    committed checkpoint (full resume state: step, rng, data position),
+    train the remaining schedule with async checkpointing, and obey any
+    env-configured chaos kill (TPUDL_CHAOS_* — set by the test,
+    inherited through the distributor's worker env).
+
+    Returns ``(rank, start_step, losses, final_step)`` where ``losses``
+    are the per-step losses THIS attempt computed (global schedule
+    steps ``start_step .. final_step``).
+
+    Each rank trains an identical independent replica over its LOCAL
+    devices (this container's CPU jaxlib cannot compile cross-process
+    computations; the launch/kill/restart/resume machinery under test
+    is the same either way), so every rank's loss schedule is
+    bit-identical by seeding. Rank 0 is the checkpoint writer; every
+    rank restores from the shared directory."""
+    import jax
+
+    from tpudl.ft import chaos
+    from tpudl.ft.data import ResumableIterator
+    from tpudl.ft.manager import AsyncCheckpointManager
+    from tpudl.ft.supervisor import resume_run
+    from tpudl.runtime.mesh import MeshSpec, make_mesh
+    from tpudl.train import compile_step, fit, make_classification_train_step
+
+    state = _ft_state()
+    mesh = make_mesh(MeshSpec(dp=-1), devices=jax.local_devices())
+    step = compile_step(
+        make_classification_train_step(), mesh, state, None,
+        donate_state=False,
+    )
+
+    local = _ft_batches(total_steps)
+
+    def epoch_iter(epoch):
+        return iter(local)
+
+    batches = ResumableIterator(epoch_iter)
+    with AsyncCheckpointManager(ckpt_dir) as mgr:
+        # mesh placement matters in multi-process: restored leaves must
+        # come back as GLOBAL (replicated) arrays, not single-device.
+        state, rng, batches, start = resume_run(
+            mgr, state, batches, mesh=mesh
+        )
+        if rng is None:
+            rng = jax.random.key(1)
+
+        kill_hook = chaos.step_kill_hook()
+        losses = []
+
+        def logger(i, metrics):
+            losses.append(metrics["loss"])
+            if kill_hook is not None:
+                # Drain the writer before dying so WHICH checkpoint is
+                # committed at kill time is deterministic (torn-write
+                # crash shapes are covered by the store unit tests).
+                mgr.wait_until_finished()
+                kill_hook(start + i)  # i is 1-based within this fit
+
+        state, _, _ = fit(
+            step, state, batches, rng,
+            num_steps=total_steps - start,
+            log_every=1, logger=logger,
+            checkpoint_manager=mgr, checkpoint_every=ckpt_every,
+        )
+    return jax.process_index(), start, losses, int(state.step)
+
+
+def rank_dependent_worker():
+    """Rank 1 raises, rank 0 logs a clue and succeeds — drives the
+    failure-report path that must include SURVIVING workers' log
+    tails."""
+    import jax
+
+    if jax.process_index() == 1:
+        raise RuntimeError("rank1 poisoned the well")
+    print("rank0 survivor breadcrumb: saw nothing wrong")
+    import sys
+
+    sys.stdout.flush()
+    return "ok-rank0"

@@ -23,8 +23,14 @@ A ground-up JAX/XLA/pjit/Pallas re-design of the capability surface of
   periodic async checkpointing with resume.
 - ``tpudl.obs``      — cross-layer runtime observability: host-side
   span/counter recording through the loops, checkpointing, ingest, and
-  distributor workers; goodput accounting and the straggler report CLI
+  distributor workers; goodput accounting (incl. lost-to-recovery
+  classification) and the straggler report CLI
   (``python -m tpudl.obs.report``). Stdlib-only, free when disabled.
+- ``tpudl.ft``       — fault tolerance: async checkpointing with atomic
+  commit (bounded on-step stall, background writer), full resume state
+  (step / rng key / data position), SIGTERM grace-window preemption
+  handling, supervised elastic restart with retry budget, and the
+  chaos-injection harness that keeps all of it tested.
 - ``tpudl.export``   — StableHLO export, cross-backend numerical parity and
   latency benchmarking — the reference's signature behavior
   (reference: notebooks/cv/onnx_experiments.py:81-144) rebuilt as a

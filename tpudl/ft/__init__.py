@@ -1,0 +1,63 @@
+"""tpudl.ft — fault tolerance: async checkpointing, preemption
+handling, supervised elastic restart, and fault injection.
+
+The recovery layer between "benchmark harness" and "trainable for
+days" on preemptible TPU capacity:
+
+- ``tpudl.ft.store``      — staging + atomic-commit checkpoint layout
+  (a checkpoint is committed in full or invisible);
+- ``tpudl.ft.writer``     — background writer thread: the step path
+  pays only the device->host snapshot + back-pressure, never the IO;
+- ``tpudl.ft.manager``    — AsyncCheckpointManager: CheckpointManager-
+  compatible API carrying FULL resume state (step, RNG key, data
+  position) with corruption fallback and clear shape-mismatch errors;
+- ``tpudl.ft.preemption`` — SIGTERM/SIGINT grace-window protocol:
+  cooperative emergency checkpoint, hard-exit watchdog;
+- ``tpudl.ft.supervisor`` — Supervisor: cohort restart with
+  exponential backoff under a retry budget, plus ``resume_run``, the
+  resume-idempotent payload prologue;
+- ``tpudl.ft.data``       — ResumableIterator: checkpointable
+  (epoch, offset) data position;
+- ``tpudl.ft.chaos``      — fault injection (worker kills, checkpoint
+  truncation, IO delay) for the end-to-end kill/resume tests.
+
+Attributes resolve lazily (PEP 562): ``tpudl.train.loop`` imports the
+preemption flag on its hot path and must not drag jax-importing
+submodules in transitively.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "AsyncCheckpointManager": ("tpudl.ft.manager", "AsyncCheckpointManager"),
+    "CheckpointStore": ("tpudl.ft.store", "CheckpointStore"),
+    "CheckpointCorruptError": ("tpudl.ft.store", "CheckpointCorruptError"),
+    "CheckpointShapeError": ("tpudl.ft.store", "CheckpointShapeError"),
+    "AsyncCheckpointWriter": ("tpudl.ft.writer", "AsyncCheckpointWriter"),
+    "PreemptionGuard": ("tpudl.ft.preemption", "PreemptionGuard"),
+    "Supervisor": ("tpudl.ft.supervisor", "Supervisor"),
+    "SupervisorGaveUp": ("tpudl.ft.supervisor", "SupervisorGaveUp"),
+    "RestartPolicy": ("tpudl.ft.supervisor", "RestartPolicy"),
+    "resume_run": ("tpudl.ft.supervisor", "resume_run"),
+    "ResumableIterator": ("tpudl.ft.data", "ResumableIterator"),
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'tpudl.ft' has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,149 @@
+"""Async checkpoint writer: bounded on-step stall, background IO.
+
+The step path pays only for (a) back-pressure, if the previous save has
+not committed yet — at most ONE save is in flight — and (b) the
+device->host snapshot, which is bandwidth-bounded and must complete
+before the train loop donates the state's buffers to the next compiled
+step. Serialization, fsync, the atomic commit, and retention all happen
+on a persistent daemon writer thread, overlapped with training.
+
+Obs accounting: the snapshot/back-pressure stall records under
+``CAT_CHECKPOINT`` (true step-path time lost) while the background
+write records under ``CAT_CKPT_BG``, which the goodput classifier
+treats as overlapped — it never counts against the run's wall-clock
+budget (tpudl.obs.goodput).
+
+A write failure is NOT swallowed: it is re-raised on the next
+``submit``/``wait``/``close`` so the training driver finds out before
+it relies on a checkpoint that never landed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from tpudl.ft.store import CheckpointStore
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import spans as obs_spans
+
+
+class AsyncCheckpointWriter:
+    """Single-slot background writer over a CheckpointStore."""
+
+    def __init__(self, store: CheckpointStore):
+        self._store = store
+        self._lock = threading.Lock()
+        self._job_ready = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._job: Optional[tuple] = None
+        self._busy = False
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="tpudl-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    # -- step-path API -------------------------------------------------
+
+    def submit(
+        self,
+        step: int,
+        leaves: List[Tuple[str, "object"]],
+        extra_meta: Optional[dict] = None,
+        delay_hook: Optional[Callable[[], None]] = None,
+    ) -> float:
+        """Queue one serialized-ready payload. Blocks (back-pressure)
+        while a previous save is still being written; raises any
+        deferred writer error. Returns the seconds spent blocked —
+        the CALLER's enclosing save span accounts them (a nested span
+        of the same category would double-count in the goodput sums)."""
+        import time as _time
+
+        waited = 0.0
+        with self._lock:
+            self._raise_deferred_locked()
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            if self._busy or self._job is not None:
+                t0 = _time.monotonic()
+                while self._busy or self._job is not None:
+                    self._idle.wait()
+                waited = _time.monotonic() - t0
+            self._raise_deferred_locked()
+            self._job = (step, leaves, extra_meta, delay_hook)
+            self._busy = True
+            self._job_ready.notify()
+        return waited
+
+    def wait(self) -> None:
+        """Block until no save is in flight; raise any deferred error."""
+        with self._lock:
+            while self._busy or self._job is not None:
+                self._idle.wait()
+            self._raise_deferred_locked()
+
+    def close(self) -> None:
+        """Drain, stop the thread, and surface any deferred error."""
+        with self._lock:
+            if self._closed:
+                self._raise_deferred_locked()
+                return
+            while self._busy or self._job is not None:
+                self._idle.wait()
+            self._closed = True
+            self._job_ready.notify()
+        self._thread.join(timeout=30.0)
+        with self._lock:
+            self._raise_deferred_locked()
+
+    @property
+    def in_flight(self) -> bool:
+        with self._lock:
+            return self._busy or self._job is not None
+
+    def _raise_deferred_locked(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "async checkpoint write failed (deferred from the "
+                "writer thread)"
+            ) from err
+
+    # -- writer thread -------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while self._job is None and not self._closed:
+                    self._job_ready.wait()
+                if self._job is None and self._closed:
+                    return
+                step, leaves, extra_meta, delay_hook = self._job
+                self._job = None
+            try:
+                rec = obs_spans.active_recorder()
+                t0 = rec.clock() if rec is not None else None
+                committed = self._store.write(
+                    step, leaves, extra_meta=extra_meta,
+                    delay_hook=delay_hook,
+                )
+                self._store.retain()
+                reg = obs_counters.registry()
+                if rec is not None:
+                    dur = rec.clock() - t0
+                    rec.record(
+                        "checkpoint_write", obs_spans.CAT_CKPT_BG, t0, dur,
+                        {"step": step, "committed": committed},
+                    )
+                    reg.histogram("checkpoint_write_s").observe(dur)
+                if committed:
+                    reg.counter("checkpoint_saves").inc()
+            except BaseException as e:  # deferred to the step path
+                with self._lock:
+                    self._error = e
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._idle.notify_all()

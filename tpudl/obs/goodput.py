@@ -25,10 +25,12 @@ from typing import Dict, Iterable, Optional, Tuple
 
 from tpudl.obs.spans import (
     CAT_CHECKPOINT,
+    CAT_CKPT_BG,
     CAT_COMPILE,
     CAT_DATA_WAIT,
     CAT_ENCLOSING,
     CAT_EVAL,
+    CAT_RECOVERY,
     CAT_STEP,
 )
 
@@ -36,13 +38,15 @@ from tpudl.obs.spans import (
 #: else lands in "other_s").
 GOODPUT_CATEGORIES = (
     CAT_STEP, CAT_EVAL, CAT_COMPILE, CAT_DATA_WAIT, CAT_CHECKPOINT,
+    CAT_RECOVERY,
 )
 
 #: Lifetime spans that ENCLOSE categorized spans on the same clock
-#: (a distributor worker_run): they extend the run window but are never
-#: accounted time — summing them would double-count their interior and
-#: wipe out idle.
-_WINDOW_ONLY_CATS = (CAT_ENCLOSING,)
+#: (a distributor worker_run), plus deliberately-OVERLAPPED work (the
+#: async checkpoint writer runs concurrently with train steps): they
+#: extend the run window but are never accounted time — summing them
+#: would double-count their interior and wipe out idle.
+_WINDOW_ONLY_CATS = (CAT_ENCLOSING, CAT_CKPT_BG)
 
 
 def process_key(record: dict) -> tuple:
@@ -78,11 +82,13 @@ def classify(
 
     ``window`` overrides the run extent (seconds on the recording
     process's clock); default is [earliest span start, latest span end].
-    Enclosing lifetime spans (cat "worker") only widen the window.
+    Enclosing lifetime spans (cat "worker") and overlapped background
+    writes (cat "ckpt_bg") only widen the window.
     Returns ``{"wall_s", "steps", "productive_s", "eval_s", "compile_s",
-    "data_wait_s", "checkpoint_s", "other_s", "idle_s", "goodput"}``
-    where productive_s counts train steps, eval_s counts eval steps,
-    and goodput = (productive_s + eval_s) / wall_s — useful work over
+    "data_wait_s", "checkpoint_s", "recovery_s", "other_s", "idle_s",
+    "goodput"}`` where productive_s counts train steps, eval_s counts
+    eval steps, recovery_s is wall-clock lost to failure recovery, and
+    goodput = (productive_s + eval_s) / wall_s — useful work over
     wall-clock.
     """
     spans = [r for r in records if r.get("kind") == "span"]
@@ -117,6 +123,7 @@ def classify(
         "compile_s": per_cat[CAT_COMPILE],
         "data_wait_s": per_cat[CAT_DATA_WAIT],
         "checkpoint_s": per_cat[CAT_CHECKPOINT],
+        "recovery_s": per_cat[CAT_RECOVERY],
         "other_s": other,
         "idle_s": idle,
         "goodput": useful / wall if wall > 0 else 0.0,
@@ -145,7 +152,8 @@ def classify_by_process(records: Iterable[dict]) -> dict:
         k: sum(c[k] for c in per.values())
         for k in (
             "wall_s", "steps", "productive_s", "eval_s", "compile_s",
-            "data_wait_s", "checkpoint_s", "other_s", "idle_s",
+            "data_wait_s", "checkpoint_s", "recovery_s", "other_s",
+            "idle_s",
         )
     } if per else classify([])
     if per:
@@ -165,12 +173,17 @@ def format_goodput(cls: dict) -> str:
         return 100.0 * x / wall if wall > 0 else 0.0
 
     useful = cls["productive_s"] + cls.get("eval_s", 0.0)
+    recovery = cls.get("recovery_s", 0.0)
+    recovery_part = (
+        f"recovery {pct(recovery):.1f}%, " if recovery > 0 else ""
+    )
     return (
         f"goodput {100.0 * cls['goodput']:.1f}% "
         f"({useful:.2f}s useful of {wall:.2f}s wall; "
         f"compile {pct(cls['compile_s']):.1f}%, "
         f"data_wait {pct(cls['data_wait_s']):.1f}%, "
         f"checkpoint {pct(cls['checkpoint_s']):.1f}%, "
+        f"{recovery_part}"
         f"other {pct(cls['other_s']):.1f}%, "
         f"idle {pct(cls['idle_s']):.1f}%)"
     )

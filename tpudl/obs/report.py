@@ -34,17 +34,20 @@ from tpudl.obs import goodput as goodput_mod
 from tpudl.obs.counters import percentile
 from tpudl.obs.spans import (
     CAT_CHECKPOINT,
+    CAT_CKPT_BG,
     CAT_COMPILE,
     CAT_DATA_WAIT,
     CAT_EVAL,
+    CAT_RECOVERY,
     CAT_STEP,
     chrome_trace_events,
     read_jsonl,
 )
 
-#: Table row order: the lifecycle order of one step.
+#: Table row order: the lifecycle order of one step; the overlapped
+#: background-write row and recovery last (present only when nonzero).
 _TABLE_CATS = (CAT_DATA_WAIT, CAT_STEP, CAT_EVAL, CAT_COMPILE,
-               CAT_CHECKPOINT)
+               CAT_CHECKPOINT, CAT_CKPT_BG, CAT_RECOVERY)
 
 
 def load_records(paths: Iterable[str]) -> List[dict]:

@@ -51,6 +51,14 @@ CAT_EVAL = "eval"
 CAT_COMPILE = "compile"
 CAT_DATA_WAIT = "data_wait"
 CAT_CHECKPOINT = "checkpoint"
+#: Time lost to failure recovery (supervisor backoff between a cohort
+#: death and its relaunch) — accounted as lost wall-clock, the
+#: "lost-to-recovery" column of the goodput report.
+CAT_RECOVERY = "recovery"
+#: Background checkpoint writes (tpudl.ft.writer): they OVERLAP train
+#: steps by design, so the classifier reports them but never charges
+#: them against the run's wall-clock budget.
+CAT_CKPT_BG = "ckpt_bg"
 #: Enclosing lifetime spans (a distributor worker's whole run): they
 #: OVERLAP the categorized spans inside them, so the goodput classifier
 #: uses them only to extend the run window, never as accounted time.

@@ -92,6 +92,36 @@ def param_shardings(mesh: Mesh, params: Any, rules: Optional[Rules] = None) -> A
     return tree_shardings(mesh, params, rules)
 
 
+def host_to_global_array(x: Any, sharding: "jax.sharding.Sharding"):
+    """Place a host value onto ``sharding`` even when the sharding spans
+    NON-addressable devices (a multi-process mesh), where plain
+    ``jax.device_put`` refuses host inputs.
+
+    ``x`` is interpreted as the GLOBAL value; each process materializes
+    only its addressable shards (``jax.make_array_from_callback``) — the
+    multi-process placement path for replicated train state, rng keys,
+    and checkpoint-restored leaves. Scalars/ints go through
+    ``jnp.asarray`` first so weak-typing matches what device_put would
+    have produced (a Python int stays int32, not numpy's int64).
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    import numpy as np
+
+    if not isinstance(x, (np.ndarray, jax.Array)):
+        x = jax.numpy.asarray(x)
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        raise ValueError(
+            "host_to_global_array needs a host value or fully-"
+            f"addressable array; got a global array sharded as "
+            f"{x.sharding}"
+        )
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
 # ---------------------------------------------------------------------------
 # Activation-sharding constraints.
 #

@@ -47,6 +47,61 @@ def _free_port() -> int:
 
 
 @dataclasses.dataclass
+class WorkerFailure:
+    """One failed worker, classified: ``kind`` is "exception" (the
+    payload raised in Python), "exit" (died without a result — killed,
+    OOMed, segfaulted; ``signal`` carries the signal number when the
+    exit code encodes one), "exit-after-result" (returned a value but
+    exited nonzero), or "timeout"."""
+
+    pid: int
+    kind: str
+    detail: str
+    returncode: Optional[int] = None
+    signal: Optional[int] = None
+
+    def describe(self) -> str:
+        head = f"[process {self.pid}] {self.kind}"
+        if self.signal is not None:
+            import signal as _signal
+
+            try:
+                name = _signal.Signals(self.signal).name
+            except ValueError:
+                name = str(self.signal)
+            head += f" (signal {name})"
+        elif self.returncode not in (None, 0):
+            head += f" (exit code {self.returncode})"
+        return f"{head}: {self.detail}"
+
+
+class WorkerFailedError(RuntimeError):
+    """Cohort launch failed. ``failures`` carries the classified root
+    failures; ``survivor_logs`` the log tails of every OTHER worker
+    (peer-terminated or completed), which is where the actual cause
+    often surfaces — e.g. the rank that logged the poison value before
+    a PEER crashed on it."""
+
+    def __init__(
+        self,
+        num_processes: int,
+        failures: List[WorkerFailure],
+        survivor_logs: "dict[int, str]",
+    ):
+        self.failures = failures
+        self.survivor_logs = survivor_logs
+        detail = "\n---\n".join(f.describe() for f in failures)
+        if survivor_logs:
+            detail += "\n---\nsurviving-worker log tails:"
+            for pid, tail in sorted(survivor_logs.items()):
+                detail += f"\n[process {pid}] {tail}"
+        super().__init__(
+            f"TpuDistributor: {len(failures)}/{num_processes} "
+            f"worker(s) failed:\n{detail}"
+        )
+
+
+@dataclasses.dataclass
 class TpuDistributor:
     """Launches a callable across JAX processes.
 
@@ -57,7 +112,10 @@ class TpuDistributor:
       platform: JAX platform for spawned workers ("cpu" for local testing,
         "tpu" on pods). In-process mode never overrides the platform.
       devices_per_process: fake host devices per worker (CPU platform only).
-      timeout_s: per-worker wall-clock limit for local spawn.
+      timeout_s: cohort wall-clock limit for local spawn.
+      peer_grace_s: after the FIRST worker failure, how long surviving
+        workers get to finish before the launcher tears them down
+        (peers blocked on a collective with the dead rank never will).
     """
 
     num_processes: int = 1
@@ -65,6 +123,7 @@ class TpuDistributor:
     platform: str = "cpu"
     devices_per_process: int = 1
     timeout_s: float = 600.0
+    peer_grace_s: float = 5.0
 
     @classmethod
     def pod(cls) -> "TpuDistributor":
@@ -238,58 +297,112 @@ class TpuDistributor:
                 return "<no log>"
 
         results: List[Any] = [None] * self.num_processes
-        failures = []
-        # One shared deadline: after the first timeout every peer blocked on
-        # a collective with the dead worker is killed promptly instead of
-        # burning its own full timeout_s.
-        deadline = time.monotonic() + self.timeout_s
-        timed_out = False
-        for pid, p, result_path, log_path in procs:
-            remaining = deadline - time.monotonic()
-            if timed_out or remaining <= 0:
-                remaining = 5.0  # short grace for peers of a dead worker
-            try:
-                p.wait(timeout=remaining)
-            except subprocess.TimeoutExpired:
-                timed_out = True
-                p.kill()
-                p.wait()
-                failures.append(
-                    (pid, f"timeout after {self.timeout_s}s\n{read_log(log_path)}")
-                )
-                continue
+        completed: List[int] = []
+        failures: List[WorkerFailure] = []
+        peer_terminated: dict = {}
+
+        def collect(pid: int, p, result_path: str, log_path: str) -> None:
+            """Classify one finished worker: success, a Python
+            exception in the payload, an exit WITHOUT a result (killed
+            / OOM / segfault — the signal is decoded from the exit
+            code), or a result followed by a nonzero exit."""
             try:
                 with open(result_path, "rb") as f:
                     status, value = pickle.load(f)
-            except FileNotFoundError:
+            except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+                rc = p.returncode
+                sig = -rc if (rc is not None and rc < 0) else None
                 failures.append(
-                    (
-                        pid,
-                        f"exit code {p.returncode}, no result file\n"
-                        f"{read_log(log_path)}",
+                    WorkerFailure(
+                        pid, "exit",
+                        f"no result file\n{read_log(log_path)}",
+                        returncode=rc, signal=sig,
                     )
                 )
-                continue
+                return
             if status == "ok" and p.returncode == 0:
                 results[pid] = value
+                completed.append(pid)
             elif status == "ok":
                 failures.append(
-                    (
-                        pid,
+                    WorkerFailure(
+                        pid, "exit-after-result",
                         f"worker returned a result but exited with code "
                         f"{p.returncode}\n{read_log(log_path)}",
+                        returncode=p.returncode,
                     )
                 )
             else:
-                failures.append((pid, f"worker exception: {value}"))
-        if failures:
-            # Kill any stragglers before reporting.
-            for _, p, _, _ in procs:
-                if p.poll() is None:
+                failures.append(
+                    WorkerFailure(
+                        pid, "exception", f"worker exception: {value}",
+                        returncode=p.returncode,
+                    )
+                )
+
+        # Poll ALL workers instead of waiting rank-by-rank: a worker
+        # SIGKILLed mid-collective is detected within a poll interval,
+        # its peers (blocked on the dead rank forever) get a short
+        # grace, then the cohort is torn down and reported — the
+        # supervisor's restart latency is the poll interval, not the
+        # full timeout budget.
+        pending = {
+            pid: (p, result_path, log_path)
+            for pid, p, result_path, log_path in procs
+        }
+        deadline = time.monotonic() + self.timeout_s
+        grace_deadline: Optional[float] = None
+        timed_out = False
+        while pending:
+            for pid in sorted(pending):
+                p, result_path, log_path = pending[pid]
+                if p.poll() is not None:
+                    del pending[pid]
+                    collect(pid, p, result_path, log_path)
+            if not pending:
+                break
+            now = time.monotonic()
+            if grace_deadline is None and (failures or now >= deadline):
+                # First failure OR the cohort budget spent: survivors
+                # get peer_grace_s to finish naturally (a near-done
+                # peer classifies by its real outcome, not as
+                # collateral) before the launcher tears down.
+                timed_out = not failures and now >= deadline
+                grace_deadline = now + self.peer_grace_s
+            if grace_deadline is not None and now >= grace_deadline:
+                # Decide ONCE: either the teardown is a pure-timeout
+                # one (every still-pending worker is a root timeout)
+                # or a peer teardown after real failures.
+                as_timeouts = timed_out and not failures
+                for pid in sorted(pending):
+                    p, result_path, log_path = pending.pop(pid)
                     p.kill()
-            detail = "\n---\n".join(f"[process {pid}] {msg}" for pid, msg in failures)
-            raise RuntimeError(
-                f"TpuDistributor: {len(failures)}/{self.num_processes} "
-                f"worker(s) failed:\n{detail}"
+                    p.wait()
+                    if as_timeouts:
+                        # Budget spent, nobody else failed: the still-
+                        # running workers ARE the root cause.
+                        failures.append(
+                            WorkerFailure(
+                                pid, "timeout",
+                                f"timeout after {self.timeout_s}s\n"
+                                f"{read_log(log_path)}",
+                            )
+                        )
+                    else:
+                        # Peers of a dead worker: terminated by the
+                        # launcher, NOT root failures — but their logs
+                        # often hold the real story, so keep the tails
+                        # for the error detail.
+                        peer_terminated[pid] = read_log(log_path)
+                break
+            time.sleep(0.05)
+
+        if failures:
+            survivor_logs = dict(peer_terminated)
+            for pid, _, _, log_path in procs:
+                if pid in completed:
+                    survivor_logs[pid] = read_log(log_path)
+            raise WorkerFailedError(
+                self.num_processes, failures, survivor_logs
             )
         return results

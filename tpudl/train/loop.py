@@ -21,6 +21,7 @@ import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tpudl.ft import preemption as ft_preemption
 from tpudl.obs import counters as obs_counters
 from tpudl.obs import spans as obs_spans
 from tpudl.parallel.sharding import (
@@ -28,6 +29,7 @@ from tpudl.parallel.sharding import (
     active_mesh,
     constrain,
     current_mesh,
+    host_to_global_array,
     tree_shardings,
 )
 from tpudl.runtime.mesh import batch_partition_spec
@@ -441,7 +443,30 @@ def compile_step(
             sh_leaves = [shardings] * len(leaves)
         else:
             sh_leaves = jax.tree.leaves(shardings)
-        placed = jax.device_put(leaves, sh_leaves)
+        # Multi-process shardings span non-addressable devices, where
+        # device_put refuses host values: build those leaves from their
+        # addressable shards instead (make_array_from_callback, treating
+        # the host value as the GLOBAL value — correct for the
+        # replicated state/rng leaves; batch columns in multi-process
+        # runs arrive as already-global arrays and pass through).
+        placed: list = [None] * len(leaves)
+        put_idx: list = []
+        for idx, (leaf, sh) in enumerate(zip(leaves, sh_leaves)):
+            if sh.is_fully_addressable:
+                put_idx.append(idx)
+            elif isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                placed[idx] = leaf  # already global; jit validates it
+            else:
+                placed[idx] = host_to_global_array(leaf, sh)
+        if put_idx:
+            for idx, arr in zip(
+                put_idx,
+                jax.device_put(
+                    [leaves[i] for i in put_idx],
+                    [sh_leaves[i] for i in put_idx],
+                ),
+            ):
+                placed[idx] = arr
         return jax.tree.unflatten(treedef, placed)
 
     state_treedef = jax.tree.structure(state)
@@ -566,7 +591,19 @@ def fit(
     shards flush) and once at the end. Saves are keyed by the state's own
     step counter, so a restored-and-continued run lines up with the
     schedule of an uninterrupted one. Use `resume_latest` to restore
-    before calling fit.
+    before calling fit. Managers whose ``save`` accepts ``rng`` /
+    ``data_state`` (both backends of tpudl.checkpoint.CheckpointManager)
+    get the FULL resume state: the training rng key and — when
+    ``batches`` exposes a ``state()`` position (tpudl.ft.
+    ResumableIterator) — the data position, so ``tpudl.ft.resume_run``
+    restarts schedule-identically without replaying batches or dropout
+    masks.
+
+    Preemption (tpudl.ft.preemption): when a grace-window handler is
+    installed and a SIGTERM/SIGINT has arrived, the loop stops before
+    the next step, writes the final checkpoint (the EMERGENCY save —
+    same end-of-fit path), and returns with ``info["preempted"] =
+    True`` so the worker can exit cleanly within the grace window.
 
     Observability (tpudl.obs): with TPUDL_OBS_DIR set (or
     tpudl.obs.enable called), every step records a data-wait span (time
@@ -603,10 +640,48 @@ def fit(
     start_step = (
         int(state.step) if checkpoint_manager is not None else 0
     )
+    # Full-resume support is a capability of the manager's save
+    # signature (both tpudl.checkpoint backends have it; third-party
+    # managers with the legacy 2-arg save keep working).
+    full_resume = False
+    if checkpoint_manager is not None:
+        import inspect
+
+        try:
+            save_params = inspect.signature(
+                checkpoint_manager.save
+            ).parameters
+            full_resume = (
+                "rng" in save_params and "data_state" in save_params
+            )
+        except (TypeError, ValueError):
+            pass
+    data_position = getattr(batches, "state", None)
+
+    def _save_ckpt(step_no, state):
+        if full_resume:
+            checkpoint_manager.save(
+                step_no, state, rng=rng,
+                data_state=(
+                    data_position() if callable(data_position) else None
+                ),
+            )
+        else:
+            checkpoint_manager.save(step_no, state)
+
+    preempted = False
     it = iter(batches)
     i = 0
     try:
         while num_steps is None or i < num_steps:
+            if ft_preemption.requested():
+                # Grace window is ticking: stop pulling work; the
+                # emergency checkpoint is the end-of-fit save below.
+                preempted = True
+                if rec is not None:
+                    rec.event("preempted", "recovery", step=i)
+                obs_counters.registry().counter("ft_preemptions").inc()
+                break
             if rec is None:
                 try:
                     batch = next(it)
@@ -649,7 +724,7 @@ def fit(
                     # Safe despite the next step donating `state`'s
                     # buffers: CheckpointManager.save copies device->host
                     # before returning (see its docstring invariant).
-                    checkpoint_manager.save(step_no, state)
+                    _save_ckpt(step_no, state)
             if log_every and (i + 1) % log_every == 0:
                 host_metrics = {k: float(v) for k, v in metrics.items()}
                 if logger:
@@ -665,12 +740,22 @@ def fit(
     if checkpoint_manager is not None and n:
         step_no = start_step + n
         if not checkpoint_every or step_no % checkpoint_every != 0:
-            checkpoint_manager.save(step_no, state)
+            # Doubles as the preemption EMERGENCY save: on a grace-
+            # window exit this is the last committed state the
+            # supervisor's restarted cohort resumes from.
+            _save_ckpt(step_no, state)
         checkpoint_manager.wait_until_finished()
+        if rec is not None:
+            # Re-snapshot: the final save's counters/histograms landed
+            # after the loop's finally-block snapshot (the report keeps
+            # the LAST snapshot per process).
+            rec.counters(obs_counters.registry().snapshot())
     if metrics is not None:
         metrics = {k: float(v) for k, v in metrics.items()}
     elapsed = time.perf_counter() - start
-    return state, metrics, {"steps": n, "seconds": elapsed}
+    return state, metrics, {
+        "steps": n, "seconds": elapsed, "preempted": preempted,
+    }
 
 
 def evaluate(
@@ -790,7 +875,9 @@ def resume_latest(
     Returns ``(state, resumed_step)`` — ``(state, 0)`` untouched when the
     directory is empty, so cold start and resume are one call site.
     Fast-forward the data past the consumed steps, or the resumed run
-    re-trains on early batches:
+    re-trains on early batches (``tpudl.ft.resume_run`` does this
+    automatically, restoring the checkpointed rng key and data position
+    too):
 
         state, start_step = resume_latest(mgr, state, mesh, rules)
         fit(step, state, itertools.islice(batches, start_step, None), rng,
