@@ -33,13 +33,21 @@ from tpudl.runtime import use_hardware_rng
 use_hardware_rng()
 
 # Values banked in BASELINE.md (1x TPU v5 lite).
-# Protocol hygiene (round 5): the measurement below is best-of-4-windows,
-# so the banked side must be too — the best of the same-day
-# best-of-window runs 25.1k/29.9k/35.0k/36.9k. Like-vs-like (best vs
-# best); the key name carries the protocol. The ambient relay throughput
-# drifts ~±20% across hours, so treat this ratio as noisy regardless;
-# the BERT metric's 170 ms steps are stable ±1.5% and carry the headline.
-BASELINE_RESNET_IMAGES_PER_SEC_BEST = 36_900.0
+# Protocol correction (round 6, the BENCH_r05 0.923 investigation): the
+# round-5 "best vs best" bank compared each round's SINGLE
+# best-of-4-windows run against the MAX of four same-day
+# best-of-4-windows runs (25.1k/29.9k/35.0k/36.9k -> 36.9k) — an
+# order-statistic mismatch: one draw of a ±20% one-sided-noise metric
+# almost never reaches the max of four draws, so the ratio reads < 1.0
+# with no code change (the r05 bisect confirms: this bench feeds a
+# synthetic device-resident batch and touches neither prefetch depth
+# nor wire format). Corrected bank: the MEDIAN of those four
+# same-protocol runs, so both sides of the ratio are single
+# best-of-4-windows draws. The BERT metric's 170 ms steps hold ±1.5%
+# and carry the headline; benchmarks/dispatch_overhead.py now tracks
+# the dispatch stalls that make short-step metrics noisy in the first
+# place.
+BASELINE_RESNET_IMAGES_PER_SEC_BEST = 32_450.0
 BASELINE_RESNET50_IMAGES_PER_SEC = 2482.6  # banked 2026-07-30 (round 2)
 # Re-banked at batch 256 (round 2 close: 1320 samples/sec/chip) so
 # vs_baseline is a like-for-like speedup at the same config — the old
@@ -66,6 +74,10 @@ BERT_BATCH = 256
 BERT_SEQ = 128
 BERT_WARMUP_STEPS = 15
 BERT_MEASURE_STEPS = 30
+# Fused-dispatch comparison width: 8 steps per compiled dispatch (the
+# tentpole's default recommendation; benchmarks/dispatch_overhead.py
+# sweeps other widths).
+BERT_FUSED_K = 8
 
 
 def _bench_resnet():
@@ -241,9 +253,55 @@ def _bench_bert():
 
     step_seconds = elapsed / BERT_MEASURE_STEPS
     samples_per_sec = BERT_BATCH / step_seconds / jax.device_count()
+
+    # Fused K-step dispatch (tpudl/train/loop.py steps_per_dispatch):
+    # the same step scanned 8x inside ONE executable, so the per-step
+    # host dispatch cost — the suspected driver of the three-round
+    # 0.527-MFU plateau — is paid once per 8 steps. The headline metric
+    # above stays the default single-dispatch path (the new path is off
+    # by default); this delta quantifies what turning it on recovers.
+    fused = {}
+    try:
+        from benchmarks.dispatch_overhead import (
+            stack_window,
+            time_fused_per_step,
+        )
+
+        step8 = compile_step(
+            make_classification_train_step(
+                input_keys=("input_ids", "attention_mask"),
+                label_key="label",
+            ),
+            mesh,
+            state,
+            None,
+            steps_per_dispatch=BERT_FUSED_K,
+        )
+        window = jax.device_put(
+            stack_window(batch, BERT_FUSED_K), step8.window_sharding
+        )
+        fused_step_seconds, _ = time_fused_per_step(
+            step8, state, window, rng, BERT_FUSED_K,
+            warmup_dispatches=2, dispatches=4,
+        )
+        fused = {
+            "step_dispatch_overhead_ms": round(
+                (step_seconds - fused_step_seconds) * 1e3, 3
+            ),
+            "fused_dispatch_speedup": round(
+                step_seconds / fused_step_seconds, 3
+            ),
+        }
+    except Exception:
+        import sys
+        import traceback
+
+        print("fused-dispatch bench failed:", file=sys.stderr)
+        traceback.print_exc()
+
     return samples_per_sec, mfu(
         flops, step_seconds, jax.device_count(), device_peak_flops()
-    )
+    ), fused
 
 
 def _bench_bert_large():
@@ -372,7 +430,7 @@ def _bench_ft():
 
 
 def main():
-    bert_sps, bert_mfu = _bench_bert()
+    bert_sps, bert_mfu, bert_fused = _bench_bert()
     resnet_ips = _bench_resnet()
     resnet50_ips = _bench_resnet50()
     bl_sps, bl_mfu, bl_mfu_compiled = _bench_bert_large()
@@ -421,6 +479,17 @@ def main():
                 "vs_baseline": round(vs_baseline, 3),
                 "mfu": round(bert_mfu, 4),
                 "bert_batch": BERT_BATCH,
+                # Fused K-step dispatch (steps_per_dispatch=8) vs the
+                # single-dispatch headline above: per-step wall-time
+                # delta and ratio (benchmarks/dispatch_overhead.py has
+                # the width sweep). The headline path stays
+                # single-dispatch — the fused path is opt-in.
+                "step_dispatch_overhead_ms": bert_fused.get(
+                    "step_dispatch_overhead_ms"
+                ),
+                "fused_dispatch_speedup": bert_fused.get(
+                    "fused_dispatch_speedup"
+                ),
                 "resnet50_imagenet_images_per_sec_chip": round(resnet50_ips, 1),
                 "resnet50_vs_baseline": round(
                     resnet50_ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3
@@ -430,7 +499,12 @@ def main():
                 "resnet18_images_per_sec_chip_best_of_windows": round(
                     resnet_ips, 1
                 ),
-                "resnet18_vs_baseline_best_vs_best": round(
+                # Ratio base corrected round 6: median (not max) of the
+                # banked same-protocol best-of-4-windows runs, so both
+                # sides are single draws — see BASELINE.md (the r05
+                # 0.923 was the max-of-4 denominator bias, not a
+                # regression).
+                "resnet18_vs_baseline_like_protocol": round(
                     resnet_ips / BASELINE_RESNET_IMAGES_PER_SEC_BEST, 3
                 ),
                 # configs[3] building block at its DECLARED batch 256 via

@@ -206,6 +206,8 @@ class _Pipeline:
         assembly_workers: int,
         host_depth: int,
         obs_bytes=None,
+        window: int = 1,
+        place_window: Optional[Callable[[Dict], Dict]] = None,
     ):
         self.src = iter(iterator)
         self.src_lock = threading.Lock()
@@ -214,6 +216,15 @@ class _Pipeline:
         self.place = place
         self.transform = transform
         self.obs_bytes = obs_bytes
+        # Window mode (the fused-dispatch feed): the transfer stage
+        # groups `window` consecutive host batches, stacks them into ONE
+        # [K, B, ...] host array per column, and places the whole window
+        # in a single H2D transfer — no per-batch device arrays to
+        # re-stack on device later. Queue items become tagged tuples
+        # ("w", placed_window, k) / ("s", placed_single); window == 1
+        # keeps the untagged single-batch protocol byte-identical.
+        self.window = max(1, int(window))
+        self.place_window = place_window
         self.host_q = _BoundedQueue(host_depth)
         self.device_q = _BoundedQueue(depth)
         self.error: Optional[BaseException] = None
@@ -306,22 +317,64 @@ class _Pipeline:
                     pass
 
     def transfer(self) -> None:
+        import numpy as np
+
         pending: dict = {}
         emit = 0
         total = None
+        group: list = []
         try:
             while True:
                 while emit in pending:
                     batch = pending.pop(emit)
-                    self.last_host_bytes = _tree_nbytes(batch)
-                    if self.obs_bytes is not None:
-                        self.obs_bytes.inc(self.last_host_bytes)
-                    self.device_q.put(self.place(batch))
                     emit += 1
                     with self.ahead:
                         self.emitted = emit
                         self.ahead.notify_all()
+                    if self.window > 1:
+                        if group and any(
+                            np.shape(batch[k]) != np.shape(group[0][k])
+                            for k in group[0]
+                        ):
+                            # Shape break mid-group (e.g. a dataset's
+                            # smaller partial batch): a stacked window
+                            # must be homogeneous, so flush the group
+                            # as tagged singles — the consumer falls
+                            # back to the single-step program, exactly
+                            # like the ragged tail.
+                            for b in group:
+                                if self.obs_bytes is not None:
+                                    self.obs_bytes.inc(_tree_nbytes(b))
+                                self.device_q.put(("s", self.place(b)))
+                            group = []
+                        group.append(batch)
+                        if len(group) == self.window:
+                            stacked = {
+                                k: np.stack([b[k] for b in group])
+                                for k in group[0]
+                            }
+                            group = []
+                            self.last_host_bytes = _tree_nbytes(stacked)
+                            if self.obs_bytes is not None:
+                                self.obs_bytes.inc(self.last_host_bytes)
+                            self.device_q.put((
+                                "w", self.place_window(stacked),
+                                self.window,
+                            ))
+                    else:
+                        self.last_host_bytes = _tree_nbytes(batch)
+                        if self.obs_bytes is not None:
+                            self.obs_bytes.inc(self.last_host_bytes)
+                        self.device_q.put(self.place(batch))
                 if total is not None and emit >= total:
+                    # Ragged tail in window mode: fewer than `window`
+                    # batches remain — emit them as tagged singles for
+                    # the consumer's single-step fallback.
+                    for b in group:
+                        if self.obs_bytes is not None:
+                            self.obs_bytes.inc(_tree_nbytes(b))
+                        self.device_q.put(("s", self.place(b)))
+                    group = []
                     self.device_q.put(_END)
                     return
                 item = self.host_q.get()
@@ -368,6 +421,7 @@ class DevicePrefetcher:
         autotuner: Optional[PrefetchAutotuner] = None,
         host_depth: Optional[int] = None,
         clock: Callable[[], float] = time.perf_counter,
+        window: int = 1,
     ):
         import jax
 
@@ -375,17 +429,27 @@ class DevicePrefetcher:
             raise ValueError(
                 f"assembly_workers must be >= 1, got {assembly_workers}"
             )
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
         depth = max(1, int(depth))
         if autotuner is not None:
             autotuner.depth = max(autotuner.depth, depth)
 
         sharding = None
+        window_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
 
-            from tpudl.runtime.mesh import batch_partition_spec
+            from tpudl.runtime.mesh import (
+                batch_partition_spec,
+                window_partition_spec,
+            )
 
             sharding = NamedSharding(mesh, batch_partition_spec())
+            if window > 1:
+                window_sharding = NamedSharding(
+                    mesh, window_partition_spec()
+                )
 
         def place(batch: Dict) -> Dict:
             # Closure over jax + sharding only — never over the handle.
@@ -396,6 +460,22 @@ class DevicePrefetcher:
                 }
             return jax.device_put(batch)
 
+        def place_window(stacked: Dict) -> Dict:
+            # The fused-dispatch feed: one [K, localB, ...] host array
+            # per column becomes one [K, B, ...] device window — scan
+            # axis replicated, batch axis sharded — in a single H2D
+            # transfer (tpudl.runtime.mesh.window_partition_spec).
+            if window_sharding is not None:
+                return {
+                    k: jax.make_array_from_process_local_data(
+                        window_sharding, v
+                    )
+                    for k, v in stacked.items()
+                }
+            return jax.device_put(stacked)
+
+        self._window = int(window)
+        self._held: collections.deque = collections.deque()
         self._autotuner = autotuner
         self._clock = clock
 
@@ -419,6 +499,8 @@ class DevicePrefetcher:
             assembly_workers,
             host_depth if host_depth is not None else assembly_workers + 2,
             obs_bytes=obs_bytes,
+            window=window,
+            place_window=place_window,
         )
         # Reaps the workers when the handle is dropped without close()
         # (and at interpreter exit). The callback holds only the
@@ -447,7 +529,9 @@ class DevicePrefetcher:
             ) from err
         raise err
 
-    def __next__(self):
+    def _pull_item(self):
+        """One device-queue pull with the shared error/close protocol;
+        returns ``(item, wait_seconds)`` or raises StopIteration."""
         if self._p.error is not None:
             self._raise_error()
         if self._p.closed:
@@ -467,6 +551,9 @@ class DevicePrefetcher:
         if item is _END:
             self.close()  # workers already exited; reap them now
             raise StopIteration
+        return item, wait
+
+    def _observe(self, wait: float) -> None:
         if self._autotuner is not None:
             new_depth = self._autotuner.observe(
                 wait, self._p.last_host_bytes
@@ -475,7 +562,63 @@ class DevicePrefetcher:
                 self._p.device_q.set_capacity(new_depth)
                 if self._obs_gauge is not None:
                     self._obs_gauge.set(new_depth)
+
+    def __next__(self):
+        if self._held:
+            return self._held.popleft()
+        item, wait = self._pull_item()
+        self._observe(wait)
+        if self._window > 1:
+            tag, payload = item[0], item[1]
+            if tag == "w":
+                # Window item consumed through the iterator protocol:
+                # unstack lazily into singles (device-side slices) so
+                # plain iteration stays correct — but fused consumers
+                # should call pull_window() and skip this copy.
+                import jax
+
+                k = item[2]
+                self._held.extend(
+                    jax.tree.map(lambda a, j=j: a[j], payload)
+                    for j in range(k)
+                )
+                return self._held.popleft()
+            return payload
         return item
+
+    def pull_window(self, k: Optional[int] = None):
+        """Next stacked [K, B, ...] device window (K = the constructor's
+        ``window``), or None once the stream holds fewer than K batches
+        — drain the ragged tail by iterating normally. The fused-
+        dispatch feed: the window was assembled host-side and crossed
+        the H2D link as one transfer, so no device-side stacking
+        happens on this path."""
+        if self._window <= 1:
+            raise ValueError(
+                "pull_window() needs a window-mode prefetcher "
+                "(prefetch_to_device(window=K))"
+            )
+        if k is not None and k != self._window:
+            raise ValueError(
+                f"pull_window({k}) on a window={self._window} prefetcher"
+            )
+        if self._held:
+            return None  # singles pending: the stream is past its windows
+        try:
+            item, wait = self._pull_item()
+        except StopIteration:
+            return None
+        self._observe(wait)
+        tag, payload = item[0], item[1]
+        if tag == "w":
+            return payload
+        self._held.append(payload)  # ragged-tail single: hand to iteration
+        return None
+
+    @property
+    def window(self) -> int:
+        """Batches per assembled dispatch window (1 = single-batch)."""
+        return self._window
 
     @property
     def depth(self) -> int:
@@ -510,6 +653,7 @@ def prefetch_to_device(
     max_depth: int = DEFAULT_MAX_DEPTH,
     byte_budget: int = DEFAULT_BYTE_BUDGET,
     target_wait_s: float = DEFAULT_TARGET_WAIT_S,
+    window: int = 1,
 ) -> DevicePrefetcher:
     """Overlap host batch assembly + H2D transfer with device compute.
 
@@ -530,6 +674,14 @@ def prefetch_to_device(
     ``target_wait_s``, within ``byte_budget`` bytes of staged batches.
     The ``TPUDL_PREFETCH_DEPTH`` environment variable pins the depth and
     disables autotuning (operator escape hatch).
+
+    ``window=K`` > 1 assembles K consecutive batches into one
+    [K, B, ...] stacked window host-side and ships it in a single H2D
+    transfer — the feed for ``fit(steps_per_dispatch=K)``'s fused
+    K-step dispatch (``DevicePrefetcher.pull_window``); a ragged tail
+    of fewer than K batches arrives as single batches through normal
+    iteration. Note a staged slot then holds K batches, so effective
+    byte budgeting scales accordingly.
 
     Returns a :class:`DevicePrefetcher` — a plain iterator with
     ``close()`` (and context-manager support) that reaps its worker
@@ -554,4 +706,5 @@ def prefetch_to_device(
         transform=transform,
         assembly_workers=assembly_workers,
         autotuner=autotuner,
+        window=window,
     )

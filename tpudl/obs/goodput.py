@@ -30,6 +30,7 @@ from tpudl.obs.spans import (
     CAT_DATA_WAIT,
     CAT_ENCLOSING,
     CAT_EVAL,
+    CAT_METRIC_WAIT,
     CAT_RECOVERY,
     CAT_STEP,
 )
@@ -37,8 +38,8 @@ from tpudl.obs.spans import (
 #: Categories with a dedicated column in the classification (anything
 #: else lands in "other_s").
 GOODPUT_CATEGORIES = (
-    CAT_STEP, CAT_EVAL, CAT_COMPILE, CAT_DATA_WAIT, CAT_CHECKPOINT,
-    CAT_RECOVERY,
+    CAT_STEP, CAT_EVAL, CAT_COMPILE, CAT_DATA_WAIT, CAT_METRIC_WAIT,
+    CAT_CHECKPOINT, CAT_RECOVERY,
 )
 
 #: Lifetime spans that ENCLOSE categorized spans on the same clock
@@ -106,7 +107,11 @@ def classify(
         if cat in per_cat:
             per_cat[cat] += dur
             if cat == CAT_STEP:
-                steps += 1
+                # A fused dispatch_window span covers K train steps in
+                # one record (its "window" attr); count them all so
+                # goodput-per-step stays comparable across dispatch
+                # modes.
+                steps += int(s.get("window", 1) or 1)
         else:
             other += dur
     if window is not None:
@@ -122,6 +127,7 @@ def classify(
         "eval_s": per_cat[CAT_EVAL],
         "compile_s": per_cat[CAT_COMPILE],
         "data_wait_s": per_cat[CAT_DATA_WAIT],
+        "metric_wait_s": per_cat[CAT_METRIC_WAIT],
         "checkpoint_s": per_cat[CAT_CHECKPOINT],
         "recovery_s": per_cat[CAT_RECOVERY],
         "other_s": other,
@@ -152,8 +158,8 @@ def classify_by_process(records: Iterable[dict]) -> dict:
         k: sum(c[k] for c in per.values())
         for k in (
             "wall_s", "steps", "productive_s", "eval_s", "compile_s",
-            "data_wait_s", "checkpoint_s", "recovery_s", "other_s",
-            "idle_s",
+            "data_wait_s", "metric_wait_s", "checkpoint_s", "recovery_s",
+            "other_s", "idle_s",
         )
     } if per else classify([])
     if per:
@@ -177,11 +183,16 @@ def format_goodput(cls: dict) -> str:
     recovery_part = (
         f"recovery {pct(recovery):.1f}%, " if recovery > 0 else ""
     )
+    metric_wait = cls.get("metric_wait_s", 0.0)
+    metric_part = (
+        f"metric_wait {pct(metric_wait):.1f}%, " if metric_wait > 0 else ""
+    )
     return (
         f"goodput {100.0 * cls['goodput']:.1f}% "
         f"({useful:.2f}s useful of {wall:.2f}s wall; "
         f"compile {pct(cls['compile_s']):.1f}%, "
         f"data_wait {pct(cls['data_wait_s']):.1f}%, "
+        f"{metric_part}"
         f"checkpoint {pct(cls['checkpoint_s']):.1f}%, "
         f"{recovery_part}"
         f"other {pct(cls['other_s']):.1f}%, "

@@ -38,6 +38,7 @@ from tpudl.obs.spans import (
     CAT_COMPILE,
     CAT_DATA_WAIT,
     CAT_EVAL,
+    CAT_METRIC_WAIT,
     CAT_RECOVERY,
     CAT_STEP,
     chrome_trace_events,
@@ -47,7 +48,7 @@ from tpudl.obs.spans import (
 #: Table row order: the lifecycle order of one step; the overlapped
 #: background-write row and recovery last (present only when nonzero).
 _TABLE_CATS = (CAT_DATA_WAIT, CAT_STEP, CAT_EVAL, CAT_COMPILE,
-               CAT_CHECKPOINT, CAT_CKPT_BG, CAT_RECOVERY)
+               CAT_METRIC_WAIT, CAT_CHECKPOINT, CAT_CKPT_BG, CAT_RECOVERY)
 
 
 def load_records(paths: Iterable[str]) -> List[dict]:
@@ -102,33 +103,40 @@ def build_report(
     # Outlier steps: anything beyond outlier_factor x the p50 TRAIN-step
     # time (eval steps have their own duration scale and stay out of
     # these statistics), attributed to host/process so cross-host blips
-    # are visible.
+    # are visible. Fused dispatch_window spans cover K steps each (the
+    # "window" attr), so their duration normalizes to per-step time
+    # before comparison — a K=8 window is not an 8x outlier.
     step_spans = [s for s in spans if s.get("cat") == CAT_STEP]
+
+    def _per_step_dur(s) -> float:
+        return float(s["dur"]) / int(s.get("window", 1) or 1)
+
     outliers: List[dict] = []
     p50 = (
-        percentile(sorted(float(s["dur"]) for s in step_spans), 0.50)
+        percentile(sorted(_per_step_dur(s) for s in step_spans), 0.50)
         if step_spans else 0.0
     )
     if p50 > 0:
         for s in step_spans:
-            if float(s["dur"]) > outlier_factor * p50:
+            dur = _per_step_dur(s)
+            if dur > outlier_factor * p50:
                 outliers.append({
                     "host": s.get("host", "?"),
                     "process": s.get("process", 0),
                     "step": s.get("step"),
-                    "ms": 1e3 * float(s["dur"]),
-                    "x_p50": float(s["dur"]) / p50,
+                    "ms": 1e3 * dur,
+                    "x_p50": dur / p50,
                 })
         outliers.sort(key=lambda o: -o["ms"])
 
-    # Per-host/process straggler attribution over step-span means
+    # Per-host/process straggler attribution over per-step means
     # (grouped by recording process incl. OS pid — see
     # goodput.process_key).
     per_host_keyed: Dict[tuple, List[float]] = {}
     for s in step_spans:
         per_host_keyed.setdefault(
             goodput_mod.process_key(s), []
-        ).append(float(s["dur"]))
+        ).append(_per_step_dur(s))
     labels = goodput_mod.process_labels(per_host_keyed)
     per_host = {
         labels[k]: per_host_keyed[k]

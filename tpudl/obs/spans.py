@@ -50,6 +50,11 @@ CAT_STEP = "step"
 CAT_EVAL = "eval"
 CAT_COMPILE = "compile"
 CAT_DATA_WAIT = "data_wait"
+#: Time the train loop blocked on metric readback (the async drain's
+#: backpressure or its end-of-fit flush) — separate from data_wait so a
+#: report distinguishes "starved for batches" from "throttled by
+#: telemetry".
+CAT_METRIC_WAIT = "metric_wait"
 CAT_CHECKPOINT = "checkpoint"
 #: Time lost to failure recovery (supervisor backoff between a cohort
 #: death and its relaunch) — accounted as lost wall-clock, the

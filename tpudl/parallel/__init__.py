@@ -1,6 +1,12 @@
 """Parallelism: sharding rules, activation constraints, pipeline
-schedules (GPipe / 1F1B / interleaved virtual stages)."""
+schedules (GPipe / 1F1B / interleaved virtual stages), overlap-friendly
+bucketed gradient accumulation."""
 
+from tpudl.parallel.overlap import (  # noqa: F401
+    accumulate as bucketed_accumulate,
+    bucket_assignment,
+    bucket_bytes_from_env,
+)
 from tpudl.parallel.pipeline import (  # noqa: F401
     PIPELINE_RULES,
     interleave_stage_order,

@@ -1,5 +1,6 @@
 """L0 runtime: device/mesh discovery and distributed bring-up."""
 
+from tpudl.runtime.compile_cache import enable_compile_cache  # noqa: F401
 from tpudl.runtime.distributor import TpuDistributor  # noqa: F401
 from tpudl.runtime.mesh import (  # noqa: F401
     AXIS_DATA,
@@ -13,5 +14,11 @@ from tpudl.runtime.mesh import (  # noqa: F401
     apply_platform_env,
     batch_partition_spec,
     make_mesh,
+    window_partition_spec,
 )
 from tpudl.runtime.rng import use_hardware_rng  # noqa: F401
+
+# Honor TPUDL_COMPILE_CACHE at import — before the first jit compiles —
+# so every entrypoint that touches the runtime gets the persistent
+# cache without its own plumbing. No-op when the knob is unset.
+enable_compile_cache()

@@ -173,6 +173,14 @@ def batch_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
     return NamedSharding(mesh, batch_partition_spec(extra_dims))
 
 
+def window_partition_spec(extra_dims: int = 0) -> PartitionSpec:
+    """PartitionSpec for a [K, B, ...] stacked dispatch window (the
+    fused multi-step path): the scan axis is replicated — every device
+    steps through the same K slots — and the batch dim shards over
+    (dp, fsdp) exactly as a single batch would."""
+    return PartitionSpec(None, BATCH_AXES, *([None] * extra_dims))
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     """Per-process batch size given a global batch sharded over (dp, fsdp)."""
     n_shards = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]

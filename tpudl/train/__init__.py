@@ -1,6 +1,7 @@
 """L3 training: Optax loops, pjit sharding, metrics, structured logging."""
 
 from tpudl.train.logging import MetricLogger  # noqa: F401
+from tpudl.train.metrics import MetricFetcher  # noqa: F401
 from tpudl.train.loop import (  # noqa: F401
     TrainState,
     compile_step,

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from tpudl.ft import preemption as ft_preemption
 from tpudl.obs import counters as obs_counters
 from tpudl.obs import spans as obs_spans
+from tpudl.parallel import overlap as grad_overlap
 from tpudl.parallel.sharding import (
     Rules,
     active_mesh,
@@ -32,7 +33,7 @@ from tpudl.parallel.sharding import (
     host_to_global_array,
     tree_shardings,
 )
-from tpudl.runtime.mesh import batch_partition_spec
+from tpudl.runtime.mesh import batch_partition_spec, window_partition_spec
 
 
 def microbatch(batch: dict, accum_steps: int) -> dict:
@@ -120,6 +121,7 @@ def make_classification_train_step(
     moe_aux_weight: float = 0.0,
     accum_steps: int = 1,
     input_transform: Optional[Callable[[dict], dict]] = None,
+    overlap_bucket_mb: Optional[float] = None,
 ) -> Callable:
     """Train step for image/sequence classification models.
 
@@ -152,11 +154,27 @@ def make_classification_train_step(
     host->device link, the scale+bias fuses into the first conv). Under
     accumulation it applies after the microbatch split, so the full
     batch stays in its compact wire dtype.
+
+    Under accumulation the per-microbatch gradient add goes through
+    ``tpudl.parallel.overlap.accumulate``: gradient leaves bucket in
+    traversal order and each bucket's add carries its own optimization
+    barrier, so on multi-device meshes XLA can interleave each bucket's
+    cross-device reduction with the remaining backward compute instead
+    of one monolithic end-of-microbatch sync. Identity on values
+    (test_accumulation parity unchanged); ``overlap_bucket_mb``
+    overrides the ``TPUDL_OVERLAP_BUCKET_MB`` default, and on a single
+    batch shard the bucketing self-disables (nothing to overlap).
     """
     if isinstance(input_keys, str):
         input_keys = (input_keys,)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    # None = auto (env knob, else default-on-multi-shard); an explicit
+    # 0 disables — mapped to 0 bytes, which accumulate() treats as off.
+    overlap_bucket_bytes = (
+        None if overlap_bucket_mb is None
+        else int(overlap_bucket_mb * (1 << 20))
+    )
 
     def _sown_aux(mutated: dict) -> jax.Array:
         """Sum only the sown ``moe_aux_loss`` entries (other intermediates
@@ -234,7 +252,9 @@ def make_classification_train_step(
                     state, state.params, stats,
                     mb, jax.random.fold_in(step_rng, a),
                 )
-                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                grads_acc = grad_overlap.accumulate(
+                    grads_acc, grads, bucket_bytes=overlap_bucket_bytes
+                )
                 metrics_acc = jax.tree.map(jnp.add, metrics_acc, metrics)
                 return (grads_acc, new_stats, metrics_acc), None
 
@@ -362,6 +382,7 @@ def compile_step(
     donate_state: Optional[bool] = None,
     has_rng: bool = True,
     preprocess: Optional[Callable[[dict], dict]] = None,
+    steps_per_dispatch: int = 1,
 ) -> Callable:
     """jit a (state, batch[, rng]) step with mesh shardings.
 
@@ -384,9 +405,34 @@ def compile_step(
     ``make_classification_train_step(input_transform=...)`` instead
     applies per microbatch, which keeps the full batch in its compact
     wire dtype under accumulation — prefer that for ``accum_steps > 1``.
+
+    ``steps_per_dispatch=K`` > 1 additionally compiles a FUSED K-step
+    program — a ``lax.scan`` of ``step_fn`` over a [K, B, ...] stacked
+    batch window — exposed as ``wrapped.window_step(state, window,
+    rng)``, which returns the final state plus [K]-stacked per-step
+    metrics from ONE device dispatch. Why: each single dispatch pays
+    host dispatch latency (pathological through the TPU relay, and the
+    round-5 bench's BERT-base plateau); fusing K steps pays it once per
+    K. Semantics are bit-for-bit identical to K single dispatches with
+    the same ``rng``: the scan threads the state carry exactly as the
+    caller would, per-step randomness derives from ``state.step``
+    (which increments inside the carry — ``make_classification_train_
+    step`` folds it), and the carry keeps donation. The single-step
+    program is always built too — it serves ragged tails (batch counts
+    not divisible by K) via the same ``wrapped(state, batch, rng)``
+    call. Train-only: ``has_rng=False`` steps (eval) raise.
     """
     if donate_state is None:
         donate_state = has_rng
+    if steps_per_dispatch < 1:
+        raise ValueError(
+            f"steps_per_dispatch must be >= 1, got {steps_per_dispatch}"
+        )
+    if steps_per_dispatch > 1 and not has_rng:
+        raise ValueError(
+            "steps_per_dispatch > 1 requires a train-shaped step "
+            "(has_rng=True): eval steps return no carried state to scan"
+        )
     if preprocess is not None:
         base_fn = step_fn
         if has_rng:
@@ -414,6 +460,32 @@ def compile_step(
             step_fn,
             in_shardings=(state_sh, batch_sh),
             out_shardings=repl,
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    jitted_window = None
+    window_sh = None
+    if steps_per_dispatch > 1:
+        window_sh = NamedSharding(mesh, window_partition_spec())
+
+        def _window_fn(state, window, rng):
+            # One compiled program for K steps: the scan body IS the
+            # single-step function (one copy of the layer graph in the
+            # executable), the state threads through the carry with the
+            # same donation the single-step program has, and metrics
+            # stack on the scan's ys axis -> [K] per leaf. rng passes
+            # through unchanged per inner step — exactly what fit()
+            # does across K single dispatches; per-step variation comes
+            # from folding state.step, which increments in the carry.
+            def body(carry, batch):
+                return step_fn(carry, batch, rng)
+
+            return jax.lax.scan(body, state, window)
+
+        jitted_window = jax.jit(
+            _window_fn,
+            in_shardings=(state_sh, window_sh, repl),
+            out_shardings=(state_sh, repl),
             donate_argnums=(0,) if donate_state else (),
         )
 
@@ -480,52 +552,56 @@ def compile_step(
     seen_txs = {id(state.tx): state.tx}
     _TX_WARN_CAP = 8
 
-    def wrapped(state_arg, batch, *rest):
-        if jax.tree.structure(state_arg) != state_treedef:
-            # Same array structure, different static metadata: a
-            # TrainState rebuilt by the same code carries fresh
-            # apply_fn/tx closures that compare unequal, which pjit's
-            # in_shardings prefix matching rejects. The executable
-            # encodes the ORIGINAL tx, so grafting the incoming leaves
-            # into the compile-time treedef is the correct semantics
-            # (leaf-count mismatches still raise here). Warn once PER
-            # DISTINCT incoming tx — not once per wrapper — so a second
-            # rebuilt state whose tx genuinely carries different
-            # hyperparameters (a new lr, a different schedule) is
-            # flagged too, instead of passing silently after the first
-            # warning fired.
-            tx = getattr(state_arg, "tx", None)
-            if (
-                tx is not None
-                and id(tx) not in seen_txs
-                and len(seen_txs) <= _TX_WARN_CAP
-            ):
-                seen_txs[id(tx)] = tx
-                import warnings
+    def _grafted(state_arg):
+        if jax.tree.structure(state_arg) == state_treedef:
+            return state_arg
+        # Same array structure, different static metadata: a
+        # TrainState rebuilt by the same code carries fresh
+        # apply_fn/tx closures that compare unequal, which pjit's
+        # in_shardings prefix matching rejects. The executable
+        # encodes the ORIGINAL tx, so grafting the incoming leaves
+        # into the compile-time treedef is the correct semantics
+        # (leaf-count mismatches still raise here). Warn once PER
+        # DISTINCT incoming tx — not once per wrapper — so a second
+        # rebuilt state whose tx genuinely carries different
+        # hyperparameters (a new lr, a different schedule) is
+        # flagged too, instead of passing silently after the first
+        # warning fired.
+        tx = getattr(state_arg, "tx", None)
+        if (
+            tx is not None
+            and id(tx) not in seen_txs
+            and len(seen_txs) <= _TX_WARN_CAP
+        ):
+            seen_txs[id(tx)] = tx
+            import warnings
 
-                if len(seen_txs) > _TX_WARN_CAP:
-                    warnings.warn(
-                        "compile_step: more than "
-                        f"{_TX_WARN_CAP - 1} distinct rebuilt optimizers "
-                        "grafted into this compiled step — further ones "
-                        "will not be reported individually (the "
-                        "ORIGINALLY-COMPILED optimizer still applies to "
-                        "all of them)",
-                        stacklevel=2,
-                    )
-                else:
-                    warnings.warn(
-                        "compile_step: incoming state's pytree metadata "
-                        "(apply_fn/tx) differs from the compile-time "
-                        "state; its array leaves are grafted into the "
-                        "ORIGINAL treedef and the ORIGINALLY-COMPILED "
-                        "optimizer still applies — rebuild the compiled "
-                        "step if you changed optimizer hyperparameters",
-                        stacklevel=2,
-                    )
-            state_arg = jax.tree.unflatten(
-                state_treedef, jax.tree.leaves(state_arg)
-            )
+            if len(seen_txs) > _TX_WARN_CAP:
+                warnings.warn(
+                    "compile_step: more than "
+                    f"{_TX_WARN_CAP - 1} distinct rebuilt optimizers "
+                    "grafted into this compiled step — further ones "
+                    "will not be reported individually (the "
+                    "ORIGINALLY-COMPILED optimizer still applies to "
+                    "all of them)",
+                    stacklevel=3,
+                )
+            else:
+                warnings.warn(
+                    "compile_step: incoming state's pytree metadata "
+                    "(apply_fn/tx) differs from the compile-time "
+                    "state; its array leaves are grafted into the "
+                    "ORIGINAL treedef and the ORIGINALLY-COMPILED "
+                    "optimizer still applies — rebuild the compiled "
+                    "step if you changed optimizer hyperparameters",
+                    stacklevel=3,
+                )
+        return jax.tree.unflatten(
+            state_treedef, jax.tree.leaves(state_arg)
+        )
+
+    def wrapped(state_arg, batch, *rest):
+        state_arg = _grafted(state_arg)
         state_arg = _placed(state_arg, state_sh)
         batch = _placed(batch, batch_sh)
         with active_mesh(mesh):
@@ -545,6 +621,26 @@ def compile_step(
     wrapped.batch_sharding = batch_sh
     wrapped._tpudl_mask_aware = getattr(step_fn, "_tpudl_mask_aware", False)
     wrapped._tpudl_compile_pending = True
+    wrapped.steps_per_dispatch = steps_per_dispatch
+
+    if jitted_window is not None:
+
+        def window_step(state_arg, window, *rest):
+            """Fused K-step dispatch: (state, [K, B, ...] window, rng)
+            -> (final state, [K]-stacked metrics), one device call."""
+            state_arg = _grafted(state_arg)
+            state_arg = _placed(state_arg, state_sh)
+            window = _placed(window, window_sh)
+            with active_mesh(mesh):
+                out = jitted_window(state_arg, window, *rest)
+            if wrapped._tpudl_window_compile_pending:
+                wrapped._tpudl_window_compile_pending = False
+            return out
+
+        wrapped.window_step = window_step
+        wrapped.jitted_window = jitted_window
+        wrapped.window_sharding = window_sh
+        wrapped._tpudl_window_compile_pending = True
     return wrapped
 
 
@@ -563,6 +659,33 @@ def _obs_pull(rec, it, attrs):
     return batch, dur
 
 
+def _to_host_metrics(metrics: dict) -> dict:
+    """Synchronous device->host readback of one metrics dict — the
+    blocking conversion fit()'s async drain avoids in the steady state.
+    Module-level on purpose: tests count calls to it to assert the
+    async path never fetches synchronously per logged step."""
+    return {k: float(v) for k, v in metrics.items()}
+
+
+def _stack_window(batch_list: list) -> dict:
+    """Stack K same-shape batch dicts into one [K, B, ...] window.
+
+    Host (numpy) columns stack with ``np.stack`` — one host copy, and
+    the compiled window program's placement then does a single H2D
+    transfer of the whole window. Device columns stack with
+    ``jnp.stack`` (a device-side copy); feed fit() from a window-mode
+    ``DevicePrefetcher`` (``prefetch_to_device(window=K)``) to assemble
+    the window BEFORE the H2D stage and skip that copy entirely."""
+    out = {}
+    for k in batch_list[0]:
+        vals = [b[k] for b in batch_list]
+        if all(isinstance(v, np.ndarray) for v in vals):
+            out[k] = np.stack(vals)
+        else:
+            out[k] = jnp.stack(vals)
+    return out
+
+
 def fit(
     compiled_step: Callable,
     state: TrainState,
@@ -575,9 +698,41 @@ def fit(
     profile_window: tuple = (2, 8),
     checkpoint_manager=None,
     checkpoint_every: int = 0,
+    steps_per_dispatch: Optional[int] = None,
+    async_metrics: Optional[bool] = None,
+    metric_window: int = 8,
 ):
     """Drive the compiled step over a batch iterator; returns final state and
     the last metrics (host-synced once at the end, not per step).
+
+    Fused dispatch (``steps_per_dispatch=K``, default: whatever the
+    compiled step was built with): each loop iteration pulls K batches,
+    stacks them into one [K, B, ...] window, and runs the step's fused
+    K-step program (``compile_step(..., steps_per_dispatch=K)``) — ONE
+    host dispatch and one ``dispatch_window`` span per K train steps,
+    which is the lever against per-step dispatch latency (the round-5
+    BERT-base MFU plateau). Bit-for-bit identical to K single
+    dispatches; a ragged tail (fewer than K batches left, or a
+    ``num_steps`` not divisible by K) falls back to the single-step
+    program batch by batch. Feed a window-mode prefetcher
+    (``prefetch_to_device(window=K)``) so windows assemble host-side
+    before the H2D stage; any other iterator works too (fit stacks K
+    pulls itself). Checkpoint cadence and preemption flags are honored
+    at dispatch-window granularity: a cadence step inside a window
+    commits at the window's final step, and saves stay keyed by the
+    state's true step counter so resume is schedule-identical.
+
+    Async metrics (``async_metrics``, default: on exactly when
+    ``steps_per_dispatch > 1``): per-dispatch device metrics go to a
+    ``tpudl.train.metrics.MetricFetcher`` that reads them back on its
+    own thread, so the loop never blocks on metric readback in the
+    steady state — logger callbacks still fire in step order, just up
+    to ``metric_window`` dispatches late (staleness, not loss; all of
+    them fire before fit returns). Time blocked on the fetcher
+    (backpressure past ``metric_window``, the end-of-fit flush) records
+    as ``metric_wait`` spans, separate from ``data_wait``. With async
+    off, logging synchronously fetches per logged step exactly as
+    before.
 
     Profiling (SURVEY.md §5.1): with `profile_dir` set — or the
     TPUDL_PROFILE_DIR environment variable — steps
@@ -622,6 +777,30 @@ def fit(
     profile_dir = profile_dir or os.environ.get("TPUDL_PROFILE_DIR")
     prof_start, prof_stop = profile_window
     profiling = False
+    prof_done = False  # one trace per fit: no restart after the window
+
+    if steps_per_dispatch is None:
+        K = int(getattr(compiled_step, "steps_per_dispatch", 1) or 1)
+    else:
+        K = int(steps_per_dispatch)
+    if K < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {K}")
+    window_step = getattr(compiled_step, "window_step", None) if K > 1 else None
+    if K > 1:
+        compiled_k = int(getattr(compiled_step, "steps_per_dispatch", 1) or 1)
+        if window_step is None or compiled_k != K:
+            raise ValueError(
+                f"fit(steps_per_dispatch={K}) needs a step built with "
+                f"compile_step(..., steps_per_dispatch={K}); this one "
+                f"was built with steps_per_dispatch={compiled_k}"
+            )
+
+    async_on = (K > 1) if async_metrics is None else bool(async_metrics)
+    fetcher = None
+    if async_on:
+        from tpudl.train.metrics import MetricFetcher
+
+        fetcher = MetricFetcher(window=metric_window)
 
     rec = obs_spans.active_recorder()
     if rec is not None:
@@ -629,11 +808,15 @@ def fit(
         h_step = reg.histogram("step_time_s")
         h_data = reg.histogram("data_wait_s")
         h_compile = reg.histogram("compile_time_s")
+        h_mwait = reg.histogram("metric_wait_s") if fetcher else None
         clock = rec.clock
 
-    metrics = None
+    metrics = None          # last dispatch's DEVICE metrics tree
+    metrics_count = 1       # 1 (scalar leaves) or K ([K]-stacked leaves)
+    host_metrics_last = None  # last host dict the async drain delivered
     start = time.perf_counter()
     n = 0
+    dispatches = 0
     # One host sync up front; the counter advances exactly 1 per compiled
     # step, so per-step int(state.step) (a device round-trip that would
     # stall async dispatch) is never needed.
@@ -669,20 +852,186 @@ def fit(
         else:
             checkpoint_manager.save(step_no, state)
 
+    last_ckpt_step = None
+
+    def _log_line(step_no, host_metrics):
+        if logger:
+            logger(step_no, host_metrics)
+        else:
+            print(f"step {step_no}: {host_metrics}")
+
+    def _deliver(results):
+        """Hand drained (step, host_metrics) pairs to the logger — in
+        step order (the fetcher is FIFO), possibly several dispatches
+        after the step ran (the staleness tradeoff)."""
+        nonlocal host_metrics_last
+        for step_no, hm in results:
+            host_metrics_last = hm
+            if log_every and step_no % log_every == 0:
+                _log_line(step_no, hm)
+
+    def _submit(first_step, m, count):
+        """Queue one dispatch's device metrics on the async fetcher and
+        drain whatever finished — never blocking except on the bounded
+        window (recorded as metric_wait)."""
+        if rec is not None:
+            t0 = clock()
+            waited = fetcher.submit(first_step, m, count)
+            if waited > 0:
+                rec.record(
+                    "metric_wait", obs_spans.CAT_METRIC_WAIT, t0, waited,
+                    {"step": first_step + count - 1},
+                )
+                h_mwait.observe(waited)
+        else:
+            fetcher.submit(first_step, m, count)
+        _deliver(fetcher.ready())
+
     preempted = False
     it = iter(batches)
+    use_pf_window = False
+    if K > 1 and hasattr(it, "pull_window"):
+        pf_window = int(getattr(it, "window", 1) or 1)
+        if pf_window not in (1, K):
+            raise ValueError(
+                f"batch source assembles windows of {pf_window} but "
+                f"fit runs steps_per_dispatch={K} — configure "
+                f"prefetch_to_device(window={K})"
+            )
+        use_pf_window = pf_window == K
+    windows_done = K == 1  # no fused program / no more full windows
+    from collections import deque
+
+    pending = deque()  # leftover singles from a partial window pull
     i = 0
     try:
         while num_steps is None or i < num_steps:
             if ft_preemption.requested():
                 # Grace window is ticking: stop pulling work; the
                 # emergency checkpoint is the end-of-fit save below.
+                # With K > 1 this check sits between dispatch windows —
+                # the documented preemption granularity.
                 preempted = True
                 if rec is not None:
                     rec.event("preempted", "recovery", step=i)
                 obs_counters.registry().counter("ft_preemptions").inc()
                 break
-            if rec is None:
+
+            window = None
+            if (
+                not windows_done
+                and not pending
+                and (num_steps is None or num_steps - i >= K)
+            ):
+                t0 = clock() if rec is not None else 0.0
+                if use_pf_window:
+                    window = it.pull_window()
+                    if window is None:
+                        windows_done = True
+                else:
+                    buf = []
+                    try:
+                        for _ in range(K):
+                            buf.append(next(it))
+                    except StopIteration:
+                        pass
+                    if len(buf) == K:
+                        window = _stack_window(buf)
+                    else:
+                        pending.extend(buf)
+                        windows_done = True
+                # Record even a None-returning prefetcher pull: it
+                # still blocked on the device queue (the ragged-tail
+                # single arriving) and that time is input starvation,
+                # not idle.
+                if rec is not None and (
+                    window is not None or pending or use_pf_window
+                ):
+                    dur = clock() - t0
+                    rec.record("data_wait", obs_spans.CAT_DATA_WAIT, t0,
+                               dur, {"step": i, "window": K})
+                    h_data.observe(dur)
+
+            if window is not None:
+                # Window-granularity profiling: start before the first
+                # NON-COMPILE dispatch that reaches prof_start (tracing
+                # the compile dispatch would fill the trace with XLA
+                # compile time and stop before any steady-state step).
+                if (
+                    profile_dir
+                    and not profiling
+                    and not prof_done
+                    and i + K > prof_start
+                    and not getattr(
+                        compiled_step, "_tpudl_window_compile_pending",
+                        False,
+                    )
+                ):
+                    jax.profiler.start_trace(profile_dir)
+                    profiling = True
+                if rec is None:
+                    state, metrics = window_step(state, window, rng)
+                else:
+                    is_compile = getattr(
+                        compiled_step, "_tpudl_window_compile_pending",
+                        False,
+                    )
+                    t0 = clock()
+                    state, metrics = window_step(state, window, rng)
+                    t1 = clock()
+                    if is_compile:
+                        rec.record("compile_step", obs_spans.CAT_COMPILE,
+                                   t0, t1 - t0, {"step": i, "window": K})
+                        h_compile.observe(t1 - t0)
+                    else:
+                        # ONE span covers K steps (its "window" attr is
+                        # how goodput counts them); the per-step
+                        # histogram gets K observations of the
+                        # amortized time so its count stays per-step.
+                        rec.record("dispatch_window", obs_spans.CAT_STEP,
+                                   t0, t1 - t0, {"step": i, "window": K})
+                        for _ in range(K):
+                            h_step.observe((t1 - t0) / K)
+                metrics_count = K
+                dispatches += 1
+                if profiling and prof_stop <= i + K:
+                    jax.block_until_ready(metrics)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    prof_done = True
+                n += K
+                i += K
+                if checkpoint_manager is not None and checkpoint_every:
+                    step_no = start_step + n
+                    if (step_no // checkpoint_every) > (
+                        (step_no - K) // checkpoint_every
+                    ):
+                        # Window granularity: a cadence step inside the
+                        # window commits at the window's end, keyed by
+                        # the state's true step counter.
+                        _save_ckpt(step_no, state)
+                        last_ckpt_step = step_no
+                if fetcher is not None:
+                    _submit(i - K + 1, metrics, K)
+                elif log_every:
+                    first = i - K + 1
+                    host_all = None
+                    for s in range(first, i + 1):
+                        if s % log_every == 0:
+                            if host_all is None:
+                                host_all = {
+                                    k: np.asarray(v)
+                                    for k, v in metrics.items()
+                                }
+                            _log_line(s, {
+                                k: float(a[s - first])
+                                for k, a in host_all.items()
+                            })
+                continue
+
+            if pending:
+                batch = pending.popleft()
+            elif rec is None:
                 try:
                     batch = next(it)
                 except StopIteration:
@@ -693,7 +1042,17 @@ def fit(
                     break
                 batch, wait = pulled
                 h_data.observe(wait)
-            if profile_dir and i == prof_start:
+            if (
+                profile_dir
+                and not profiling
+                and not prof_done
+                and prof_start <= i < prof_stop
+                and not getattr(
+                    compiled_step, "_tpudl_compile_pending", False
+                )
+            ):
+                # >= (not ==): a fused run whose windows jumped past
+                # prof_start can still open the trace on a tail single.
                 jax.profiler.start_trace(profile_dir)
                 profiling = True
             if rec is None:
@@ -713,10 +1072,13 @@ def fit(
                     rec.record("train_step", obs_spans.CAT_STEP,
                                t0, t1 - t0, {"step": i})
                     h_step.observe(t1 - t0)
-            if profiling and i + 1 == prof_stop:
+            metrics_count = 1
+            dispatches += 1
+            if profiling and i + 1 >= prof_stop:
                 jax.block_until_ready(metrics)
                 jax.profiler.stop_trace()
                 profiling = False
+                prof_done = True
             n += 1
             if checkpoint_manager is not None and checkpoint_every:
                 step_no = start_step + n
@@ -725,21 +1087,49 @@ def fit(
                     # buffers: CheckpointManager.save copies device->host
                     # before returning (see its docstring invariant).
                     _save_ckpt(step_no, state)
-            if log_every and (i + 1) % log_every == 0:
-                host_metrics = {k: float(v) for k, v in metrics.items()}
-                if logger:
-                    logger(i + 1, host_metrics)
-                else:
-                    print(f"step {i + 1}: {host_metrics}")
+                    last_ckpt_step = step_no
+            if fetcher is not None:
+                _submit(i + 1, metrics, 1)
+            elif log_every and (i + 1) % log_every == 0:
+                _log_line(i + 1, _to_host_metrics(metrics))
             i += 1
     finally:
         if profiling:
             jax.profiler.stop_trace()
+        if fetcher is not None:
+            # Drain every in-flight dispatch so all logger callbacks
+            # fire (in order) before fit returns; the blocked time is
+            # the one legitimate steady-state-exempt sync point. When
+            # an exception is already propagating (often the fetcher's
+            # own sticky readback error, raised once by _submit), a
+            # second raise here would mask it — swallow the re-raise
+            # and let the original unwind.
+            import sys as _sys
+
+            propagating = _sys.exc_info()[0] is not None
+            try:
+                if rec is not None:
+                    t0 = clock()
+                    _deliver(fetcher.flush())
+                    dur = clock() - t0
+                    if dur > 0:
+                        rec.record(
+                            "metric_wait", obs_spans.CAT_METRIC_WAIT,
+                            t0, dur, {"flush": True},
+                        )
+                        h_mwait.observe(dur)
+                else:
+                    _deliver(fetcher.flush())
+            except BaseException:
+                if not propagating:
+                    raise
+            finally:
+                fetcher.close()
         if rec is not None:
             rec.counters(obs_counters.registry().snapshot())
     if checkpoint_manager is not None and n:
         step_no = start_step + n
-        if not checkpoint_every or step_no % checkpoint_every != 0:
+        if last_ckpt_step != step_no:
             # Doubles as the preemption EMERGENCY save: on a grace-
             # window exit this is the last committed state the
             # supervisor's restarted cohort resumes from.
@@ -750,11 +1140,19 @@ def fit(
             # after the loop's finally-block snapshot (the report keeps
             # the LAST snapshot per process).
             rec.counters(obs_counters.registry().snapshot())
-    if metrics is not None:
-        metrics = {k: float(v) for k, v in metrics.items()}
+    if fetcher is not None:
+        metrics = host_metrics_last
+    elif metrics is not None:
+        if metrics_count > 1:
+            metrics = {
+                k: float(np.asarray(v)[-1]) for k, v in metrics.items()
+            }
+        else:
+            metrics = _to_host_metrics(metrics)
     elapsed = time.perf_counter() - start
     return state, metrics, {
         "steps": n, "seconds": elapsed, "preempted": preempted,
+        "dispatches": dispatches, "steps_per_dispatch": K,
     }
 
 
