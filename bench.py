@@ -429,7 +429,52 @@ def _bench_ft():
     return measure_ft()
 
 
-def main():
+def _regression_gate(result: dict, strict: bool) -> int:
+    """Advisory noise-aware regression check of this run against the
+    banked BENCH_r*.json history (scripts/bench_regress.py — the
+    median-of-bank protocol BASELINE.md derived from the r05 false
+    alarm). Prints the per-metric table to stderr; only ``--strict``
+    turns a regression into a nonzero exit, so the driver's JSON line
+    always lands."""
+    import sys
+
+    try:
+        from scripts.bench_regress import (
+            default_history_paths,
+            format_rows,
+            gate,
+            normalize_round,
+        )
+
+        rows = gate(normalize_round(result), default_history_paths())
+    except Exception:
+        import traceback
+
+        print("bench_regress gate failed:", file=sys.stderr)
+        traceback.print_exc()
+        # Under --strict an inoperative gate IS a failure — a CI job
+        # whose purpose is gating must not go green with the gate
+        # crashed. Advisory mode still reports the JSON line and moves
+        # on.
+        return 2 if strict else 0
+    print(format_rows(rows), file=sys.stderr)
+    regressions = [r["metric"] for r in rows if r["status"] == "regression"]
+    if regressions:
+        print(f"REGRESSION vs banked history: {', '.join(regressions)}",
+              file=sys.stderr)
+        return 1 if strict else 0
+    return 0
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when a metric regresses beyond "
+                    "its noise band vs the banked BENCH_r*.json history")
+    args = ap.parse_args(argv)
+
     bert_sps, bert_mfu, bert_fused = _bench_bert()
     resnet_ips = _bench_resnet()
     resnet50_ips = _bench_resnet50()
@@ -470,97 +515,97 @@ def main():
         if BASELINE_BERT_SAMPLES_PER_SEC
         else 1.0
     )
-    print(
-        json.dumps(
-            {
-                "metric": "bert_base_sst2_train_throughput",
-                "value": round(bert_sps, 1),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(vs_baseline, 3),
-                "mfu": round(bert_mfu, 4),
-                "bert_batch": BERT_BATCH,
-                # Fused K-step dispatch (steps_per_dispatch=8) vs the
-                # single-dispatch headline above: per-step wall-time
-                # delta and ratio (benchmarks/dispatch_overhead.py has
-                # the width sweep). The headline path stays
-                # single-dispatch — the fused path is opt-in.
-                "step_dispatch_overhead_ms": bert_fused.get(
-                    "step_dispatch_overhead_ms"
-                ),
-                "fused_dispatch_speedup": bert_fused.get(
-                    "fused_dispatch_speedup"
-                ),
-                "resnet50_imagenet_images_per_sec_chip": round(resnet50_ips, 1),
-                "resnet50_vs_baseline": round(
-                    resnet50_ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3
-                )
-                if BASELINE_RESNET50_IMAGES_PER_SEC
-                else 1.0,
-                "resnet18_images_per_sec_chip_best_of_windows": round(
-                    resnet_ips, 1
-                ),
-                # Ratio base corrected round 6: median (not max) of the
-                # banked same-protocol best-of-4-windows runs, so both
-                # sides are single draws — see BASELINE.md (the r05
-                # 0.923 was the max-of-4 denominator bias, not a
-                # regression).
-                "resnet18_vs_baseline_like_protocol": round(
-                    resnet_ips / BASELINE_RESNET_IMAGES_PER_SEC_BEST, 3
-                ),
-                # configs[3] building block at its DECLARED batch 256 via
-                # 4x64 accumulation (round 4; r3 banked 356 samples/s,
-                # 46.5% MFU at batch 64 monolithic).
-                "bert_large_samples_per_sec_chip": round(bl_sps, 1),
-                "bert_large_mfu_6nd": round(bl_mfu, 4),
-                # Compiled-cost basis (the honest one — see BASELINE.md
-                # round-5 row): live AOT cost_analysis x accum, None if
-                # the counted-once ratio guard tripped.
-                "bert_large_mfu_compiled": round(bl_mfu_compiled, 4)
-                if bl_mfu_compiled is not None
-                else None,
-                # Host feeding rate (model-free, benchmarks/
-                # input_pipeline.py): uint8-wire two-stage pipeline, with
-                # the pre-overhaul f32 single-worker feed as its ratio
-                # base — the perf trajectory of the INPUT path.
-                "input_pipeline_images_per_sec_host": round(pipe_new, 1)
-                if pipe_new is not None
-                else None,
-                "input_pipeline_vs_legacy_feed": round(
-                    pipe_new / pipe_legacy, 3
-                )
-                if pipe_new is not None and pipe_legacy
-                else None,
-                # Serving engine (tpudl.serve via benchmarks/
-                # serve_load.py): continuous-batching throughput, tail
-                # TTFT, and the continuous-vs-static speedup at equal
-                # slot count on the ragged request mix.
-                "serve_tokens_per_sec": serve.get("serve_tokens_per_sec"),
-                "serve_p99_ttft_ms": serve.get("serve_p99_ttft_ms"),
-                "serve_vs_static_batching": serve.get(
-                    "serve_vs_static_batching"
-                ),
-                # Fault tolerance (tpudl.ft via benchmarks/
-                # ft_recovery.py): the async checkpoint's mean on-step
-                # stall (vs the synchronous save of the same payload)
-                # and the kill-to-first-post-restart-step recovery
-                # time.
-                "checkpoint_step_stall_ms": round(
-                    ft["checkpoint_step_stall_ms"], 2
-                )
-                if "checkpoint_step_stall_ms" in ft
-                else None,
-                "checkpoint_sync_save_ms": round(
-                    ft["checkpoint_sync_save_ms"], 2
-                )
-                if "checkpoint_sync_save_ms" in ft
-                else None,
-                "recovery_time_sec": round(ft["recovery_time_sec"], 3)
-                if "recovery_time_sec" in ft
-                else None,
-            }
+    result = {
+        "metric": "bert_base_sst2_train_throughput",
+        "value": round(bert_sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "mfu": round(bert_mfu, 4),
+        "bert_batch": BERT_BATCH,
+        # Fused K-step dispatch (steps_per_dispatch=8) vs the
+        # single-dispatch headline above: per-step wall-time
+        # delta and ratio (benchmarks/dispatch_overhead.py has
+        # the width sweep). The headline path stays
+        # single-dispatch — the fused path is opt-in.
+        "step_dispatch_overhead_ms": bert_fused.get(
+            "step_dispatch_overhead_ms"
+        ),
+        "fused_dispatch_speedup": bert_fused.get(
+            "fused_dispatch_speedup"
+        ),
+        "resnet50_imagenet_images_per_sec_chip": round(resnet50_ips, 1),
+        "resnet50_vs_baseline": round(
+            resnet50_ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3
         )
-    )
+        if BASELINE_RESNET50_IMAGES_PER_SEC
+        else 1.0,
+        "resnet18_images_per_sec_chip_best_of_windows": round(
+            resnet_ips, 1
+        ),
+        # Ratio base corrected round 6: median (not max) of the
+        # banked same-protocol best-of-4-windows runs, so both
+        # sides are single draws — see BASELINE.md (the r05
+        # 0.923 was the max-of-4 denominator bias, not a
+        # regression).
+        "resnet18_vs_baseline_like_protocol": round(
+            resnet_ips / BASELINE_RESNET_IMAGES_PER_SEC_BEST, 3
+        ),
+        # configs[3] building block at its DECLARED batch 256 via
+        # 4x64 accumulation (round 4; r3 banked 356 samples/s,
+        # 46.5% MFU at batch 64 monolithic).
+        "bert_large_samples_per_sec_chip": round(bl_sps, 1),
+        "bert_large_mfu_6nd": round(bl_mfu, 4),
+        # Compiled-cost basis (the honest one — see BASELINE.md
+        # round-5 row): live AOT cost_analysis x accum, None if
+        # the counted-once ratio guard tripped.
+        "bert_large_mfu_compiled": round(bl_mfu_compiled, 4)
+        if bl_mfu_compiled is not None
+        else None,
+        # Host feeding rate (model-free, benchmarks/
+        # input_pipeline.py): uint8-wire two-stage pipeline, with
+        # the pre-overhaul f32 single-worker feed as its ratio
+        # base — the perf trajectory of the INPUT path.
+        "input_pipeline_images_per_sec_host": round(pipe_new, 1)
+        if pipe_new is not None
+        else None,
+        "input_pipeline_vs_legacy_feed": round(
+            pipe_new / pipe_legacy, 3
+        )
+        if pipe_new is not None and pipe_legacy
+        else None,
+        # Serving engine (tpudl.serve via benchmarks/
+        # serve_load.py): continuous-batching throughput, tail
+        # TTFT, and the continuous-vs-static speedup at equal
+        # slot count on the ragged request mix.
+        "serve_tokens_per_sec": serve.get("serve_tokens_per_sec"),
+        "serve_p99_ttft_ms": serve.get("serve_p99_ttft_ms"),
+        "serve_vs_static_batching": serve.get(
+            "serve_vs_static_batching"
+        ),
+        # Fault tolerance (tpudl.ft via benchmarks/
+        # ft_recovery.py): the async checkpoint's mean on-step
+        # stall (vs the synchronous save of the same payload)
+        # and the kill-to-first-post-restart-step recovery
+        # time.
+        "checkpoint_step_stall_ms": round(
+            ft["checkpoint_step_stall_ms"], 2
+        )
+        if "checkpoint_step_stall_ms" in ft
+        else None,
+        "checkpoint_sync_save_ms": round(
+            ft["checkpoint_sync_save_ms"], 2
+        )
+        if "checkpoint_sync_save_ms" in ft
+        else None,
+        "recovery_time_sec": round(ft["recovery_time_sec"], 3)
+        if "recovery_time_sec" in ft
+        else None,
+    }
+    print(json.dumps(result))
+    return _regression_gate(result, strict=args.strict)
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

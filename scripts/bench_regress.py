@@ -1,0 +1,319 @@
+"""Noise-aware benchmark regression gate over the BENCH_r*.json bank.
+
+The BENCH_r05 postmortem (BASELINE.md "0.923 regression" row) showed
+exactly how a naive ratio lies: comparing one draw of a ±20%
+one-sided-noise metric against the MAX of four prior draws reads as a
+regression almost always, with no code change. This gate encodes the
+corrected protocol:
+
+- the baseline for each metric is the **median of the banked
+  same-protocol history** (single draws compared against the center of
+  single draws, never against an order statistic);
+- each metric carries a **noise band**: the larger of a per-metric
+  floor (wide for the short-step relay-jittered ResNet-18 metric,
+  tight for the 170 ms BERT steps) and half the relative spread the
+  bank itself exhibits — the bank's own noise is evidence;
+- a metric is a REGRESSION only when the current draw falls outside
+  the band on the bad side (below ``median x (1 - band)`` for
+  higher-is-better, above ``median x (1 + band)`` for
+  lower-is-better), with at least ``min_history`` banked points.
+
+Usage:
+
+    python scripts/bench_regress.py CURRENT.json           # gate a run
+    python scripts/bench_regress.py --current-json '{...}' # inline
+    python scripts/bench_regress.py --self-test            # protocol test
+
+Exit status: 0 when no metric regresses (advisory rows still print),
+1 on a real regression, 2 on usage errors. ``bench.py`` runs the same
+evaluation in-process after printing its JSON line (advisory by
+default; ``bench.py --strict`` propagates the nonzero exit).
+
+The self-test replays the r05 incident from the repo's own bank:
+history r01-r04, current r05 — ResNet-18's 34,065 img/s MUST classify
+as no-regression under this protocol (it sits above the banked
+median), and a synthetic halved draw MUST still be caught.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+#: Protocol renames: historical keys folded onto one canonical metric
+#: name, so a metric's history survives its key being renamed — but
+#: ONLY where the measurement protocol stayed commensurable
+#: (best-of-windows draws of the same workload).
+ALIASES = {
+    "resnet18_cifar10_train_throughput": "resnet18_images_per_sec_chip",
+    "resnet18_images_per_sec_chip_best_of_windows":
+        "resnet18_images_per_sec_chip",
+    "bert_base_sst2_train_throughput": "bert_base_samples_per_sec_chip",
+}
+
+#: Per-metric noise-band floors (fraction of the baseline median).
+#: resnet18: the BASELINE.md-documented ±20% one-sided ambient relay
+#: drift on 9 ms steps (25.1k-36.9k same code, same day) — anything
+#: tighter re-creates the r05 false alarm. Default floor 8%: the BERT
+#: metrics hold ±1.5% but ratio bases move a few percent round to
+#: round (recompiles, jax upgrades).
+NOISE_BAND_FLOORS = {
+    "resnet18_images_per_sec_chip": 0.25,
+    "serve_tokens_per_sec": 0.20,
+    "serve_p99_ttft_ms": 0.50,
+    "input_pipeline_images_per_sec_host": 0.20,
+    "checkpoint_step_stall_ms": 0.50,
+    "checkpoint_sync_save_ms": 0.50,
+    "recovery_time_sec": 0.50,
+    "step_dispatch_overhead_ms": 1.00,
+}
+DEFAULT_BAND_FLOOR = 0.08
+
+#: Metrics where smaller is better (latency/stall/recovery); every
+#: other numeric metric is treated as higher-is-better throughput/MFU.
+LOWER_IS_BETTER = {
+    "serve_p99_ttft_ms",
+    "checkpoint_step_stall_ms",
+    "checkpoint_sync_save_ms",
+    "recovery_time_sec",
+    "step_dispatch_overhead_ms",
+}
+
+#: Non-measurement keys in a bench line: identifiers, config echoes,
+#: and ratios whose baselines are already re-derived here.
+_SKIP_KEYS = {"metric", "unit", "bert_batch"}
+
+
+def normalize_round(obj: dict) -> Dict[str, float]:
+    """One BENCH_r*.json (or a bench.py output line) -> canonical
+    ``{metric: value}``. The headline ``value`` is keyed under the
+    line's ``metric`` name; ``vs_*`` ratio fields are dropped (their
+    denominators are exactly the protocol this gate replaces)."""
+    parsed = obj.get("parsed", obj)
+    out: Dict[str, float] = {}
+    for key, value in parsed.items():
+        if key in _SKIP_KEYS or "vs_" in key:
+            continue
+        if key == "value":
+            key = parsed.get("metric", "value")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        out[ALIASES.get(key, key)] = float(value)
+    return out
+
+
+def load_round(path: str) -> Dict[str, float]:
+    with open(path) as f:
+        return normalize_round(json.load(f))
+
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def noise_band(metric: str, history: List[float]) -> float:
+    """The metric's tolerance: max(per-metric floor, half the relative
+    spread of its own bank) — a bank that scattered 20% peak-to-peak
+    testifies to >= 10% one-draw noise regardless of the floor."""
+    floor = NOISE_BAND_FLOORS.get(metric, DEFAULT_BAND_FLOOR)
+    med = _median(history)
+    if med == 0:
+        return floor
+    spread = (max(history) - min(history)) / abs(med)
+    return max(floor, spread / 2.0)
+
+
+def evaluate_regressions(
+    current: Dict[str, float],
+    history_rounds: List[Dict[str, float]],
+    min_history: int = 2,
+) -> List[dict]:
+    """Classify every current metric against the banked history.
+
+    Returns one row per metric: ``status`` is ``regression`` /
+    ``improved`` / ``ok`` / ``no-baseline`` (fewer than
+    ``min_history`` banked draws — advisory only, never gating)."""
+    rows: List[dict] = []
+    for metric in sorted(current):
+        value = current[metric]
+        hist = [
+            r[metric] for r in history_rounds
+            if metric in r and r[metric] is not None
+        ]
+        if len(hist) < min_history:
+            rows.append({
+                "metric": metric, "value": value, "baseline": None,
+                "band": None, "ratio": None, "status": "no-baseline",
+                "n_history": len(hist),
+            })
+            continue
+        baseline = _median(hist)
+        band = noise_band(metric, hist)
+        ratio = value / baseline if baseline else None
+        lower_better = metric in LOWER_IS_BETTER
+        status = "ok"
+        if ratio is not None:
+            if lower_better:
+                if ratio > 1.0 + band:
+                    status = "regression"
+                elif ratio < 1.0 - band:
+                    status = "improved"
+            else:
+                if ratio < 1.0 - band:
+                    status = "regression"
+                elif ratio > 1.0 + band:
+                    status = "improved"
+        rows.append({
+            "metric": metric, "value": value, "baseline": baseline,
+            "band": band, "ratio": ratio, "status": status,
+            "n_history": len(hist),
+        })
+    return rows
+
+
+def format_rows(rows: List[dict]) -> str:
+    lines = [
+        f"{'metric':44} {'value':>12} {'baseline':>12} {'band':>6} "
+        f"{'ratio':>7}  status",
+    ]
+    for r in rows:
+        base = f"{r['baseline']:12.2f}" if r["baseline"] is not None else (
+            f"{'—':>12}"
+        )
+        band = f"{r['band']:6.2f}" if r["band"] is not None else f"{'—':>6}"
+        ratio = f"{r['ratio']:7.3f}" if r["ratio"] is not None else (
+            f"{'—':>7}"
+        )
+        flag = r["status"].upper() if r["status"] == "regression" else (
+            r["status"]
+        )
+        lines.append(
+            f"{r['metric']:44} {r['value']:12.2f} {base} {band} {ratio}"
+            f"  {flag}"
+        )
+    return "\n".join(lines)
+
+
+def default_history_paths(root: Optional[str] = None) -> List[str]:
+    root = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def gate(
+    current: Dict[str, float],
+    history_paths: List[str],
+    min_history: int = 2,
+) -> List[dict]:
+    history = [load_round(p) for p in history_paths]
+    return evaluate_regressions(current, history, min_history=min_history)
+
+
+# ---------------------------------------------------------------------------
+# Self-test: the protocol's acceptance case IS the r05 incident.
+# ---------------------------------------------------------------------------
+
+
+def self_test(root: Optional[str] = None) -> int:
+    paths = default_history_paths(root)
+    by_name = {os.path.basename(p): p for p in paths}
+    need = [f"BENCH_r0{i}.json" for i in range(1, 6)]
+    missing = [n for n in need if n not in by_name]
+    if missing:
+        print(f"self-test needs {missing} in the repo root", file=sys.stderr)
+        return 2
+    history = [load_round(by_name[n]) for n in need[:4]]
+    r05 = load_round(by_name["BENCH_r05.json"])
+    rows = evaluate_regressions(r05, history)
+    by_metric = {r["metric"]: r for r in rows}
+
+    resnet = by_metric["resnet18_images_per_sec_chip"]
+    assert resnet["status"] != "regression", (
+        "the r05 ResNet-18 draw (34,065 img/s vs a banked median "
+        f"{resnet['baseline']:.0f}) must classify as NO-regression — "
+        "re-creating the max-of-bank false alarm the protocol exists "
+        f"to prevent: {resnet}"
+    )
+    assert by_metric["bert_base_samples_per_sec_chip"]["status"] != (
+        "regression"
+    ), by_metric["bert_base_samples_per_sec_chip"]
+
+    # And the gate still has teeth: a genuinely halved ResNet draw is
+    # outside ANY honest noise band.
+    broken = dict(r05)
+    broken["resnet18_images_per_sec_chip"] *= 0.5
+    rows2 = evaluate_regressions(broken, history)
+    bad = {r["metric"]: r for r in rows2}["resnet18_images_per_sec_chip"]
+    assert bad["status"] == "regression", bad
+
+    # Lower-is-better direction: a doubled latency regresses, a halved
+    # one improves.
+    lat_hist = [{"serve_p99_ttft_ms": v} for v in (100.0, 110.0, 105.0)]
+    worse = evaluate_regressions({"serve_p99_ttft_ms": 220.0}, lat_hist)
+    assert worse[0]["status"] == "regression", worse
+    better = evaluate_regressions({"serve_p99_ttft_ms": 40.0}, lat_hist)
+    assert better[0]["status"] == "improved", better
+
+    print("bench_regress self-test: OK (r05 classifies as no-regression; "
+          "a halved draw still gates)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Noise-aware regression gate over the BENCH_r*.json "
+        "bank (median-of-bank baselines, per-metric noise bands)"
+    )
+    ap.add_argument("current", nargs="?",
+                    help="bench output JSON file to gate ('-' = stdin)")
+    ap.add_argument("--current-json", help="inline JSON instead of a file")
+    ap.add_argument("--history", nargs="*",
+                    help="banked BENCH_r*.json files (default: the repo "
+                    "root's)")
+    ap.add_argument("--min-history", type=int, default=2,
+                    help="banked draws required before a metric gates")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--self-test", action="store_true",
+                    help="assert the r05 protocol case and exit")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+
+    if args.current_json:
+        current_obj = json.loads(args.current_json)
+    elif args.current == "-":
+        current_obj = json.loads(sys.stdin.read())
+    elif args.current:
+        with open(args.current) as f:
+            current_obj = json.load(f)
+    else:
+        ap.error("need a CURRENT json file, '-', or --current-json")
+        return 2
+
+    history_paths = (
+        args.history if args.history else default_history_paths()
+    )
+    rows = gate(
+        normalize_round(current_obj), history_paths,
+        min_history=args.min_history,
+    )
+    print(json.dumps(rows) if args.json else format_rows(rows))
+    regressions = [r for r in rows if r["status"] == "regression"]
+    if regressions:
+        print(
+            f"REGRESSION: {', '.join(r['metric'] for r in regressions)}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,243 @@
+"""tpudl.obs.slo: burn-rate window math on an injected clock, and the
+Engine's SLO-aware admission (ISSUE 6 tentpole piece 3).
+
+The acceptance scenario lives here too: a synthetic overload drives
+p99 TTFT past its objective; the monitor fires its shed callback (the
+engine sheds queued work as ``shed_slo``) and /healthz reports the
+burning objective; recovery — the fast window draining by time —
+clears both."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import tpudl.obs as obs
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import exporter as obs_exporter
+from tpudl.obs import slo as obs_slo
+from tpudl.obs.slo import Objective, SloMonitor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.disable()
+    obs_counters.registry().reset()
+    obs_exporter.stop_exporter()
+    obs_exporter._reset_health_for_tests()
+    yield
+    obs.disable()
+    obs_counters.registry().reset()
+    obs_exporter.stop_exporter()
+    obs_exporter._reset_health_for_tests()
+
+
+def _objective(**kw):
+    kw.setdefault("name", "ttft_p90")
+    kw.setdefault("metric", "serve_ttft_ms")
+    kw.setdefault("threshold", 100.0)
+    kw.setdefault("quantile", 0.9)
+    kw.setdefault("window_s", 100.0)
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("min_count", 2)
+    return Objective(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Window / burn-rate math
+# ---------------------------------------------------------------------------
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="quantile"):
+        _objective(quantile=1.0)
+    with pytest.raises(ValueError, match="fast_window_s"):
+        _objective(fast_window_s=200.0)
+    with pytest.raises(ValueError, match="duplicate"):
+        SloMonitor([_objective(), _objective()])
+    assert _objective(quantile=0.99).budget == pytest.approx(0.01)
+
+
+def test_burn_rate_arithmetic_exact():
+    """Burn rate = violating fraction / error budget, per window. 10
+    observations, 3 violating, p90 objective (budget 0.1): burn 3.0."""
+    t = [0.0]
+    mon = SloMonitor([_objective()], clock=lambda: t[0])
+    for i in range(10):
+        t[0] += 1.0
+        mon.observe("serve_ttft_ms", 500.0 if i < 3 else 50.0)
+    state = mon.evaluate()["ttft_p90"]
+    for w in ("fast", "slow"):
+        assert state[w]["count"] == 10
+        assert state[w]["violations"] == 3
+        assert state[w]["violation_fraction"] == pytest.approx(0.3)
+        assert state[w]["burn_rate"] == pytest.approx(3.0)
+    # Both windows >= their burn thresholds (default 1.0) -> burning.
+    assert state["burning"] is True
+
+
+def test_windows_trim_by_time_and_diverge():
+    """Observations age out of the fast window first: a past burst
+    keeps the slow window hot while the fast window reports clean —
+    exactly the state that must NOT alarm (sustained but not current)."""
+    t = [0.0]
+    mon = SloMonitor([_objective()], clock=lambda: t[0])
+    for _ in range(10):
+        t[0] += 1.0
+        mon.observe("serve_ttft_ms", 500.0)  # all violating, t in [1, 10]
+    assert mon.evaluate()["ttft_p90"]["burning"] is True
+    # 50s later: fast window (10s) empty, slow window (100s) still
+    # holds all 10 violations.
+    t[0] = 60.0
+    state = mon.evaluate()["ttft_p90"]
+    assert state["fast"]["count"] == 0
+    assert state["slow"]["violations"] == 10
+    assert state["fast"]["burn_rate"] == 0.0
+    assert state["slow"]["burn_rate"] == pytest.approx(10.0)
+    assert state["burning"] is False  # current-ness gate cleared it
+    # 150s: the slow window drains too.
+    t[0] = 150.0
+    state = mon.evaluate()["ttft_p90"]
+    assert state["slow"]["count"] == 0
+
+
+def test_min_count_suppresses_no_data_alarms():
+    t = [0.0]
+    mon = SloMonitor([_objective(min_count=5)], clock=lambda: t[0])
+    for _ in range(4):
+        t[0] += 1.0
+        mon.observe("serve_ttft_ms", 1e6)  # violating, but only 4 of them
+    state = mon.evaluate()["ttft_p90"]
+    assert state["fast"]["burn_rate"] == 0.0
+    assert state["burning"] is False
+    t[0] += 1.0
+    mon.observe("serve_ttft_ms", 1e6)  # the fifth arms it
+    assert mon.evaluate()["ttft_p90"]["burning"] is True
+
+
+def test_transition_callbacks_fire_once_per_edge():
+    t = [0.0]
+    mon = SloMonitor([_objective()], clock=lambda: t[0])
+    edges = []
+    mon.subscribe(lambda o, s: edges.append((o.name, s["burning"])))
+    for _ in range(5):
+        t[0] += 0.5
+        mon.observe("serve_ttft_ms", 500.0)
+    for _ in range(3):
+        mon.evaluate()  # steady state: no repeated firing
+    assert edges == [("ttft_p90", True)]
+    t[0] += 200.0
+    mon.evaluate()
+    assert edges == [("ttft_p90", True), ("ttft_p90", False)]
+    # And health() reflects the cleared state.
+    assert mon.health()["healthy"] is True
+    assert mon.health()["burning"] == []
+
+
+def test_count_cap_eviction_keeps_violation_count_consistent(monkeypatch):
+    monkeypatch.setattr(obs_slo, "MAX_WINDOW_OBS", 8)
+    t = [0.0]
+    mon = SloMonitor([_objective()], clock=lambda: t[0])
+    # 8 violations fill the cap, then 8 clean observations evict them
+    # one by one — the running violation count must follow.
+    for _ in range(8):
+        mon.observe("serve_ttft_ms", 500.0)
+    for _ in range(8):
+        mon.observe("serve_ttft_ms", 1.0)
+    state = mon.evaluate()["ttft_p90"]
+    assert state["fast"]["count"] == 8
+    assert state["fast"]["violations"] == 0
+    assert state["burning"] is False
+
+
+def test_unwatched_metric_is_ignored():
+    mon = SloMonitor([_objective()])
+    mon.observe("something_else_ms", 1e9)
+    assert mon.evaluate()["ttft_p90"]["fast"]["count"] == 0
+    assert mon.watched_metrics() == ["serve_ttft_ms"]
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: synthetic overload through the real engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+    import jax.numpy as jnp
+
+    from tpudl.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    cfg = LLAMA_TINY(dtype=jnp.float32, max_seq_len=96)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def test_engine_sheds_on_burn_and_recovers(tiny_model, tmp_path):
+    """Overload pushes TTFT far past the objective -> the monitor
+    fires, the engine sheds its queue as shed_slo, /healthz goes 503
+    naming the burning objective; once the windows drain, admission
+    serves again and /healthz recovers."""
+    from tpudl.serve import Request, ServeSession
+
+    model, params = tiny_model
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    mon = SloMonitor(
+        [_objective(window_s=1000.0, fast_window_s=100.0, min_count=2)],
+        clock=clock,
+    )
+    fired = []
+    mon.subscribe(lambda o, s: fired.append((o.name, s["burning"])))
+    session = ServeSession.from_model(
+        model, params, prompt_len=8, num_slots=2, clock=clock, slo=mon,
+    )
+    ex = obs_exporter.start_exporter(port=0)
+    url = f"http://127.0.0.1:{ex.port}/healthz"
+
+    # Six requests submitted at t=0; the "overload" is 500 virtual
+    # seconds of queue delay before the engine gets to them.
+    for i in range(6):
+        session.submit(Request(f"r{i}", [1, 2, 3], max_new_tokens=2))
+    t[0] = 500.0
+    results = session.collect()
+
+    # The first seats blew the objective (TTFT ~500s >> 100ms), the
+    # monitor fired, and the engine shed the remaining queue.
+    assert fired and fired[0] == ("ttft_p90", True)
+    served = [r for r in results.values() if r.ok]
+    shed = [r for r in results.values() if r.finish_reason == "shed_slo"]
+    assert served and shed
+    assert len(served) + len(shed) == 6
+    assert (
+        obs_counters.registry().counter("serve_requests_shed_slo").value
+        == len(shed)
+    )
+
+    # /healthz: 503, the burning objective named by both the slo source
+    # and the engine's own view.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url, timeout=10.0)
+    assert ei.value.code == 503
+    body = json.load(ei.value)
+    assert body["sources"]["slo"]["burning"] == ["ttft_p90"]
+    assert body["sources"]["serve_engine"]["slo_burning"] == ["ttft_p90"]
+
+    # Recovery: the windows drain by time alone; the probe clears...
+    t[0] += 5000.0
+    status = urllib.request.urlopen(url, timeout=10.0).status
+    assert status == 200
+    assert fired[-1] == ("ttft_p90", False)
+
+    # ...and admission serves again: fresh requests at low TTFT
+    # complete, nothing shed, still healthy.
+    for i in range(2):
+        session.submit(Request(f"ok{i}", [1, 2, 3], max_new_tokens=2))
+    results = session.collect()
+    assert all(r.ok for r in results.values())
+    assert urllib.request.urlopen(url, timeout=10.0).status == 200

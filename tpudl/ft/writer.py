@@ -39,11 +39,31 @@ class AsyncCheckpointWriter:
         self._job: Optional[tuple] = None
         self._busy = False
         self._error: Optional[BaseException] = None
+        # Unlike _error (cleared once re-raised on the step path), the
+        # health view of a write failure is STICKY: an operator probing
+        # /healthz must keep seeing "a checkpoint write failed" even
+        # after the training driver consumed the exception.
+        self._last_error: Optional[BaseException] = None
         self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="tpudl-ckpt-writer", daemon=True
         )
         self._thread.start()
+        from tpudl.obs import exporter as obs_exporter
+
+        obs_exporter.register_health_source("checkpoint_writer", self.health)
+
+    def health(self) -> dict:
+        with self._lock:
+            err = self._last_error
+            return {
+                "healthy": err is None,
+                "error": f"{type(err).__name__}: {err}"
+                if err is not None
+                else None,
+                "in_flight": self._busy or self._job is not None,
+                "closed": self._closed,
+            }
 
     # -- step-path API -------------------------------------------------
 
@@ -143,6 +163,7 @@ class AsyncCheckpointWriter:
             except BaseException as e:  # deferred to the step path
                 with self._lock:
                     self._error = e
+                    self._last_error = e
             finally:
                 with self._lock:
                     self._busy = False

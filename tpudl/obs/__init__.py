@@ -25,6 +25,14 @@ disabled: every instrumentation site guards on
 ``TPUDL_OBS_DIR=/path`` (the profiler-hook idiom) or calling
 ``tpudl.obs.enable(path)``; report with
 ``python -m tpudl.obs.report /path``.
+
+On top of the post-mortem stream sits the LIVE plane
+(``tpudl.obs.exporter``, enabled via ``TPUDL_OBS_PORT``): a stdlib
+HTTP server exposing ``/metrics`` (Prometheus text from the registry),
+``/healthz`` (heartbeats + component health sources, probe-compatible
+200/503), and ``/snapshot`` (registry + live goodput) while the
+process runs — and ``tpudl.obs.slo`` evaluates declarative latency
+objectives with burn-rate alerting over it.
 """
 
 from tpudl.obs.counters import (  # noqa: F401
@@ -34,6 +42,17 @@ from tpudl.obs.counters import (  # noqa: F401
     Registry,
     registry,
 )
+from tpudl.obs.exporter import (  # noqa: F401
+    Heartbeat,
+    ObsExporter,
+    active_exporter,
+    health_snapshot,
+    register_health_source,
+    render_prometheus,
+    start_exporter,
+    stop_exporter,
+    unregister_health_source,
+)
 from tpudl.obs.goodput import (  # noqa: F401
     classify,
     classify_by_process,
@@ -41,9 +60,12 @@ from tpudl.obs.goodput import (  # noqa: F401
 )
 from tpudl.obs.report import (  # noqa: F401
     build_report,
+    build_request_timeline,
     format_report,
+    format_request_timeline,
     load_records,
 )
+from tpudl.obs.slo import Objective, SloMonitor  # noqa: F401
 from tpudl.obs.spans import (  # noqa: F401
     SpanRecorder,
     active_recorder,

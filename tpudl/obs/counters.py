@@ -14,8 +14,12 @@ record (``SpanRecorder.counters``), so one file carries both."""
 from __future__ import annotations
 
 import math
+import os
 import threading
 from typing import Dict, List, Optional
+
+#: Default rolling-window size for Histogram (see TPUDL_OBS_HIST_WINDOW).
+DEFAULT_HIST_WINDOW = 65_536
 
 
 class Counter:
@@ -74,39 +78,79 @@ def percentile(sorted_values: List[float], q: float) -> float:
 
 
 class Histogram:
-    """Latency/size distribution. Keeps raw observations (runs are
-    bounded — a 100k-step run is ~800 KB of floats), so snapshots report
-    exact percentiles rather than bucket estimates."""
+    """Latency/size distribution over a bounded rolling window.
 
-    __slots__ = ("_lock", "_values")
+    Up to ``window`` raw observations are kept (default 65,536,
+    overridable via ``TPUDL_OBS_HIST_WINDOW``), so snapshots report
+    EXACT percentiles — of the most recent window — rather than bucket
+    estimates. Past the window the oldest observation is ring-evicted:
+    a long-lived serving process holds a fixed ~512 KB of floats per
+    histogram instead of growing without bound (and each ``snapshot()``
+    sorts a bounded list instead of the full run history). ``count``
+    and ``sum`` stay CUMULATIVE over every observation ever made — the
+    monotone pair Prometheus rate() math needs — while min/max/mean of
+    the *windowed* values describe recent behavior."""
 
-    def __init__(self):
+    __slots__ = ("_lock", "_values", "_window", "_count", "_sum")
+
+    def __init__(self, window: Optional[int] = None):
+        if window is None:
+            window = int(
+                os.environ.get("TPUDL_OBS_HIST_WINDOW", DEFAULT_HIST_WINDOW)
+            )
+        if window < 1:
+            raise ValueError(f"histogram window must be >= 1, got {window}")
         self._lock = threading.Lock()
         self._values: List[float] = []
+        self._window = window
+        self._count = 0
+        self._sum = 0.0
+
+    @property
+    def window(self) -> int:
+        return self._window
 
     def observe(self, v: float) -> None:
+        v = float(v)
         with self._lock:
-            self._values.append(float(v))
+            if len(self._values) < self._window:
+                self._values.append(v)
+            else:
+                # Ring-evict the oldest: slot i of the full buffer holds
+                # observation (count - window + i), so the write cursor
+                # is simply count modulo window.
+                self._values[self._count % self._window] = v
+            self._count += 1
+            self._sum += v
 
     @property
     def count(self) -> int:
-        return len(self._values)
+        """Cumulative observation count (not capped by the window)."""
+        return self._count
 
     @property
     def values(self) -> List[float]:
+        """The windowed observations, oldest first."""
         with self._lock:
-            return list(self._values)
+            if self._count <= self._window:
+                return list(self._values)
+            cursor = self._count % self._window
+            return self._values[cursor:] + self._values[:cursor]
 
     def snapshot(self) -> dict:
         with self._lock:
             vals = sorted(self._values)
+            count, total = self._count, self._sum
         if not vals:
             return {"count": 0}
         return {
-            "count": len(vals),
-            "sum": sum(vals),
+            "count": count,
+            "sum": total,
             "min": vals[0],
             "max": vals[-1],
+            # Windowed like min/max/percentiles (self-consistent recent
+            # view); count/sum above stay cumulative for rate() math.
+            # Identical to sum/count until the window first wraps.
             "mean": sum(vals) / len(vals),
             "p50": percentile(vals, 0.50),
             "p95": percentile(vals, 0.95),

@@ -4,6 +4,7 @@ breakdown, goodput, outliers, and per-host straggler attribution.
     python -m tpudl.obs.report /path/to/obs-dir        # or *.jsonl files
     python -m tpudl.obs.report run.jsonl --json
     python -m tpudl.obs.report run.jsonl --chrome-trace trace.json
+    python -m tpudl.obs.report serve-run.jsonl --request r17
 
 This is the "why was this run only 71% productive, and which host was
 slow" answer as an artifact, not a vibe: it loads one or many span JSONL
@@ -18,7 +19,17 @@ since every record carries host/process tags), then prints
   each attributed to its host/process;
 - per-host step-time means with stragglers flagged (mean above
   ``straggler_factor`` x the cross-host median);
+- a served-request outcome breakdown (completed vs each shed reason,
+  with queue-wait/TTFT means per reason), when a serve run's
+  ``request_complete`` events rode the stream;
 - the last counters snapshot per process, if any rode the stream.
+
+``--request <id>`` switches to per-request trace mode: the serve
+path's distributed trace (``request_id`` propagated from admission
+through prefill, every decode chunk, and completion) is stitched into
+one timeline for that request, and its TTFT is decomposed into
+queue-wait / prefill / first-decode-chunk, with the total checked
+against the measured TTFT + generation time.
 
 ``--chrome-trace`` additionally re-exports the loaded records as
 Chrome trace-event JSON for Perfetto, next to the XLA device trace."""
@@ -173,8 +184,213 @@ def build_report(
         "outlier_factor": outlier_factor,
         "per_host": host_rows,
         "straggler_factor": straggler_factor,
+        "serve_requests": serve_request_breakdown(records),
         "counters": counters,
     }
+
+
+def serve_request_breakdown(records: Iterable[dict]) -> dict:
+    """Aggregate serve ``request_complete`` events by outcome: one row
+    per finish_reason (completed-by-eos/length vs each shed reason)
+    with count and queue-wait/TTFT means — the cross-request view of
+    what admission did under load. Empty dict when the stream carries
+    no serve traffic."""
+    by_reason: Dict[str, List[dict]] = {}
+    for r in records:
+        if r.get("kind") == "event" and r.get("name") == "request_complete":
+            by_reason.setdefault(
+                r.get("finish_reason", "?"), []
+            ).append(r)
+    out: dict = {}
+    for reason in sorted(by_reason):
+        evs = by_reason[reason]
+        waits = [
+            float(e["queue_wait_s"]) for e in evs
+            if e.get("queue_wait_s") is not None
+        ]
+        ttfts = [
+            float(e["ttft_s"]) for e in evs if e.get("ttft_s") is not None
+        ]
+        out[reason] = {
+            "count": len(evs),
+            "mean_queue_wait_ms": (
+                1e3 * sum(waits) / len(waits) if waits else None
+            ),
+            "mean_ttft_ms": 1e3 * sum(ttfts) / len(ttfts) if ttfts else None,
+            "tokens": sum(int(e.get("num_tokens", 0) or 0) for e in evs),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-request trace mode (--request)
+# ---------------------------------------------------------------------------
+
+
+def build_request_timeline(records: Iterable[dict], request_id) -> dict:
+    """Stitch one request's distributed trace out of a serve run's
+    records: the admission event, the prefill span carrying its
+    ``request_id``, every decode chunk whose ``rids`` include it, and
+    the completion event — plus the TTFT/generation decomposition
+    (queue-wait / prefill / first-decode-chunk / decode total) checked
+    against the completion event's measured aggregates.
+
+    IDs are matched by string form too: a CLI ``--request 17`` finds an
+    integer request_id 17."""
+    rid = request_id
+
+    def _match(v) -> bool:
+        return v == rid or str(v) == str(rid)
+
+    queued = None
+    prefill = None
+    decode_chunks: List[dict] = []
+    complete = None
+    for r in records:
+        kind = r.get("kind")
+        if kind == "event" and _match(r.get("request_id")):
+            if r.get("name") == "request_queued":
+                queued = r
+            elif r.get("name") == "request_complete":
+                complete = r
+        elif kind == "span":
+            if _match(r.get("request_id")):
+                prefill = r
+            elif any(_match(x) for x in (r.get("rids") or ())):
+                decode_chunks.append(r)
+    if queued is None and prefill is None and complete is None:
+        raise KeyError(
+            f"no trace records carry request_id {request_id!r} — was the "
+            f"serve run recorded with TPUDL_OBS_DIR set?"
+        )
+    decode_chunks.sort(key=lambda s: float(s["ts"]))
+
+    timeline: List[dict] = []
+    if queued is not None:
+        timeline.append({
+            "ts": float(queued["ts"]), "dur": 0.0, "what": "queued",
+            "detail": {"priority": queued.get("req_priority"),
+                       "deadline_s": queued.get("deadline_s"),
+                       "depth": queued.get("depth")},
+        })
+    if prefill is not None:
+        timeline.append({
+            "ts": float(prefill["ts"]), "dur": float(prefill["dur"]),
+            "what": "prefill",
+            "detail": {"slot": prefill.get("slot")},
+        })
+    for i, c in enumerate(decode_chunks):
+        timeline.append({
+            "ts": float(c["ts"]), "dur": float(c["dur"]),
+            "what": "decode_chunk",
+            "detail": {"index": i, "busy": c.get("busy")},
+        })
+    if complete is not None:
+        timeline.append({
+            "ts": float(complete["ts"]), "dur": 0.0, "what": "complete",
+            "detail": {"finish_reason": complete.get("finish_reason"),
+                       "num_tokens": complete.get("num_tokens")},
+        })
+    timeline.sort(key=lambda e: e["ts"])
+
+    # Decomposition. Queue wait prefers the completion event's measured
+    # value (exact), falling back to prefill-start minus queued-event
+    # time (the two clocks agree when recorder and engine share one).
+    queue_wait_s = None
+    if complete is not None and complete.get("queue_wait_s") is not None:
+        queue_wait_s = float(complete["queue_wait_s"])
+    elif prefill is not None and queued is not None:
+        queue_wait_s = float(prefill["ts"]) - float(queued["ts"])
+    prefill_s = float(prefill["dur"]) if prefill is not None else None
+    decode_s = sum(float(c["dur"]) for c in decode_chunks)
+    first_chunk_s = (
+        float(decode_chunks[0]["dur"]) if decode_chunks else None
+    )
+    accounted_s = sum(
+        v for v in (queue_wait_s, prefill_s, decode_s) if v is not None
+    )
+    measured_s = None
+    ttft_s = None
+    generation_s = None
+    if complete is not None:
+        ttft_s = complete.get("ttft_s")
+        generation_s = complete.get("generation_s")
+        if ttft_s is not None:
+            measured_s = float(ttft_s) + float(generation_s or 0.0)
+    return {
+        "request_id": request_id,
+        "found": {
+            "queued": queued is not None,
+            "prefill": prefill is not None,
+            "decode_chunks": len(decode_chunks),
+            "complete": complete is not None,
+        },
+        "finish_reason": (
+            complete.get("finish_reason") if complete is not None else None
+        ),
+        "num_tokens": (
+            complete.get("num_tokens") if complete is not None else None
+        ),
+        "timeline": timeline,
+        "decomposition": {
+            "queue_wait_s": queue_wait_s,
+            "prefill_s": prefill_s,
+            "first_decode_chunk_s": first_chunk_s,
+            "decode_s": decode_s,
+            "accounted_s": accounted_s,
+            "measured_ttft_s": ttft_s,
+            "measured_generation_s": generation_s,
+            "measured_total_s": measured_s,
+            # Host bookkeeping between chunks is real wall-clock the
+            # chunks don't cover; coverage near 1.0 says the trace
+            # explains the request's life.
+            "coverage": (
+                accounted_s / measured_s
+                if measured_s not in (None, 0.0) else None
+            ),
+        },
+    }
+
+
+def format_request_timeline(tl: dict) -> str:
+    """Human rendering of ``build_request_timeline``."""
+
+    def ms(v):
+        return f"{1e3 * v:9.3f}" if v is not None else "        —"
+
+    lines = [
+        f"request {tl['request_id']} — "
+        f"finish_reason={tl['finish_reason']} "
+        f"tokens={tl['num_tokens']}",
+        "",
+        f"{'t_ms':>10} {'dur_ms':>9}  event",
+    ]
+    t0 = tl["timeline"][0]["ts"] if tl["timeline"] else 0.0
+    for e in tl["timeline"]:
+        detail = " ".join(
+            f"{k}={v}" for k, v in e["detail"].items() if v is not None
+        )
+        lines.append(
+            f"{1e3 * (e['ts'] - t0):10.3f} {1e3 * e['dur']:9.3f}  "
+            f"{e['what']}{'  [' + detail + ']' if detail else ''}"
+        )
+    d = tl["decomposition"]
+    lines += [
+        "",
+        "TTFT/generation decomposition (ms):",
+        f"  queue_wait         {ms(d['queue_wait_s'])}",
+        f"  prefill            {ms(d['prefill_s'])}",
+        f"  first_decode_chunk {ms(d['first_decode_chunk_s'])}",
+        f"  decode total       {ms(d['decode_s'])}",
+        f"  accounted          {ms(d['accounted_s'])}",
+        f"  measured ttft      {ms(d['measured_ttft_s'])}",
+        f"  measured total     {ms(d['measured_total_s'])}"
+        + (
+            f"  (coverage {d['coverage']:.3f})"
+            if d["coverage"] is not None else ""
+        ),
+    ]
+    return "\n".join(lines)
 
 
 def format_report(report: dict) -> str:
@@ -225,6 +441,25 @@ def format_report(report: dict) -> str:
                 f"{o['host']}/p{o['process']}{step}"
             )
 
+    if report.get("serve_requests"):
+        lines += [
+            "",
+            f"{'serve requests':16} {'count':>6} {'tokens':>8} "
+            f"{'q_wait_ms':>10} {'ttft_ms':>9}",
+        ]
+        for reason, r in report["serve_requests"].items():
+            qw = (
+                f"{r['mean_queue_wait_ms']:10.2f}"
+                if r["mean_queue_wait_ms"] is not None else f"{'—':>10}"
+            )
+            tt = (
+                f"{r['mean_ttft_ms']:9.2f}"
+                if r["mean_ttft_ms"] is not None else f"{'—':>9}"
+            )
+            lines.append(
+                f"{reason:16} {r['count']:6d} {r['tokens']:8d} {qw} {tt}"
+            )
+
     for key, snap in report["counters"].items():
         cs = snap.get("counters", {})
         if cs:
@@ -267,10 +502,25 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--chrome-trace", metavar="OUT.json",
                     help="also export the records as Chrome trace-event "
                     "JSON for Perfetto")
+    ap.add_argument("--request", metavar="ID",
+                    help="print ONE served request's stitched trace "
+                    "(admission -> prefill -> decode chunks -> "
+                    "completion) with its TTFT decomposition, instead "
+                    "of the run report")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
     records = load_records(args.paths)
+    if args.request is not None:
+        try:
+            tl = build_request_timeline(records, args.request)
+        except KeyError as e:
+            print(e.args[0])
+            return 1
+        print(
+            json.dumps(tl) if args.json else format_request_timeline(tl)
+        )
+        return 0
     report = build_report(
         records,
         outlier_factor=args.outlier_factor,

@@ -37,6 +37,7 @@ import tempfile
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from tpudl.obs import exporter as obs_exporter
 from tpudl.obs import spans as obs_spans
 
 
@@ -44,6 +45,40 @@ def _free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
+
+
+def _update_rank_heartbeats(
+    hearts: dict, pending_pids, obs_workers: Optional[str]
+) -> None:
+    """Refresh each rank's liveness from its span file's mtime (the
+    progress proxy the parent can read without cooperation from a hung
+    worker) and publish ``rank<N>_last_heartbeat_age_s`` gauges. A rank
+    no longer pending is stopped — exited workers are classified by
+    ``collect``, never reported hung. Without span recording (or
+    before a worker's file appears) the beat degrades to process
+    liveness — "alive" keeps the heartbeat fresh, so a healthy
+    obs-less cohort never false-flips /healthz stale; only with span
+    files does a hung-but-alive rank show as a growing age."""
+    from tpudl.obs import counters as obs_counters
+
+    reg = obs_counters.registry()
+    for pid, hb in hearts.items():
+        if pid not in pending_pids:
+            hb.stop()
+        else:
+            t = None
+            if obs_workers is not None and os.path.isdir(obs_workers):
+                hits = glob.glob(
+                    os.path.join(obs_workers, f"spans-*-p{pid}-*.jsonl")
+                )
+                if hits:
+                    t = max(os.path.getmtime(h) for h in hits)
+            hb.beat_at(time.time() if t is None else t)
+        age = hb.age_s()
+        if age is not None:
+            # Gauges keep their final value after the cohort exits —
+            # the last observation, like every other obs gauge.
+            reg.gauge(f"rank{pid}_last_heartbeat_age_s").set(age)
 
 
 @dataclasses.dataclass
@@ -296,6 +331,26 @@ class TpuDistributor:
             except OSError:
                 return "<no log>"
 
+        # Per-rank liveness: a worker proves progress by appending to
+        # its span file, so the file's mtime IS the rank's last
+        # heartbeat — the parent polls it every poll interval and
+        # publishes `rank<N>_last_heartbeat_age_s` gauges plus
+        # /healthz heartbeats. A rank hung in a collective (alive, not
+        # progressing) shows up as a growing age within seconds, not
+        # only in post-mortem straggler attribution. Without span
+        # recording the beat degrades to process liveness (see
+        # _update_rank_heartbeats).
+        launch_t = time.time()
+        hearts = {
+            pid: obs_exporter.Heartbeat(f"rank{pid}", clock=time.time)
+            for pid, *_ in procs
+        }
+        for hb in hearts.values():
+            hb.beat_at(launch_t)
+
+        def update_rank_heartbeats(pending_pids) -> None:
+            _update_rank_heartbeats(hearts, pending_pids, obs_workers)
+
         results: List[Any] = [None] * self.num_processes
         completed: List[int] = []
         failures: List[WorkerFailure] = []
@@ -359,6 +414,7 @@ class TpuDistributor:
                 if p.poll() is not None:
                     del pending[pid]
                     collect(pid, p, result_path, log_path)
+            update_rank_heartbeats(pending)
             if not pending:
                 break
             now = time.monotonic()
@@ -396,6 +452,10 @@ class TpuDistributor:
                         peer_terminated[pid] = read_log(log_path)
                 break
             time.sleep(0.05)
+        # Every exit path (drained, timeout teardown, peer teardown)
+        # leaves no rank marked running — a torn-down worker must not
+        # read as "hung" on /healthz forever after.
+        update_rank_heartbeats(pending)
 
         if failures:
             survivor_logs = dict(peer_terminated)

@@ -40,7 +40,7 @@ import numpy as np
 from tpudl.obs import registry
 from tpudl.obs.spans import active_recorder
 from tpudl.serve.cache import SlotCache
-from tpudl.serve.queue import AdmissionQueue
+from tpudl.serve.queue import CAT_SERVE_REQUEST, AdmissionQueue
 
 
 @dataclasses.dataclass
@@ -107,11 +107,17 @@ class ServeSession:
         queue_capacity: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
         continuous: bool = True,
+        slo=None,
     ):
         # Deferred import: engine imports Request/Result from this
         # module.
+        from tpudl.obs import exporter as obs_exporter
         from tpudl.serve.engine import Engine
 
+        # Live telemetry: a serving process with TPUDL_OBS_PORT set
+        # exposes /metrics, /healthz (engine slots/queue + SLO burn
+        # state), and /snapshot while it runs.
+        obs_exporter.maybe_start_from_env()
         cache = SlotCache(cache_template)
         self.queue = AdmissionQueue(
             capacity=queue_capacity
@@ -123,6 +129,12 @@ class ServeSession:
             prefill_call, decode_call, params, cache, self.queue,
             prompt_len, clock=clock, continuous=continuous,
         )
+        if slo is not None:
+            # A tpudl.obs.slo.SloMonitor: the engine feeds it
+            # TTFT/TPOT/queue-wait and sheds while objectives burn;
+            # /healthz flips 503 with the burning objective named.
+            self.engine.attach_slo(slo)
+            slo.register_as_health_source()
         self._pending_ids: set = set()
 
     # -- constructors --------------------------------------------------
@@ -257,6 +269,15 @@ class ServeSession:
                 queue_wait_s=0.0,
             )
             registry().counter("serve_requests_shed_capacity").inc()
+            rec = active_recorder()
+            if rec is not None:
+                # Capacity sheds never reach the queue, so their trace
+                # is a single completion event (queue_wait 0).
+                rec.event(
+                    "request_complete", CAT_SERVE_REQUEST, request_id=rid,
+                    finish_reason="shed_capacity", queue_wait_s=0.0,
+                    num_tokens=0,
+                )
         return rid
 
     def collect(self) -> Dict[Any, Result]:

@@ -54,7 +54,7 @@ from tpudl.obs import registry
 from tpudl.obs.spans import active_recorder
 from tpudl.serve.api import Request, Result
 from tpudl.serve.cache import SlotCache
-from tpudl.serve.queue import AdmissionQueue, _Entry
+from tpudl.serve.queue import CAT_SERVE_REQUEST, AdmissionQueue, _Entry
 
 #: Span categories (their own rows in the obs report breakdown table).
 CAT_SERVE_PREFILL = "serve_prefill"
@@ -149,24 +149,110 @@ class Engine:
         self.num_decode_steps = 0
         self.num_prefills = 0
         self.num_rollovers = 0
+        # SLO hook (attach_slo): while any subscribed objective burns,
+        # admission sheds the queue instead of seating doomed work.
+        self._slo = None
+        self._slo_burning: frozenset = frozenset()
         # Static shapes: the cache's resident bytes never change after
         # construction — publish once, not per step.
         registry().gauge("serve_cache_bytes").set(cache.nbytes)
+        # Live health: slots/queue state on /healthz while this engine
+        # is the process's serving engine (latest instance wins). The
+        # source holds a WEAK reference — a registered bound method
+        # would pin the engine and its whole SlotCache KV pytree
+        # (potentially GBs) for the process lifetime, and keep serving
+        # a dead engine's state as live readiness data.
+        import weakref
+
+        from tpudl.obs import exporter as obs_exporter
+
+        self_ref = weakref.ref(self)
+
+        def _engine_health() -> dict:
+            eng = self_ref()
+            if eng is None:
+                return {"healthy": True, "engine": "collected"}
+            return eng.health()
+
+        obs_exporter.register_health_source("serve_engine", _engine_health)
+
+    # -- live telemetry ------------------------------------------------
+
+    def health(self) -> dict:
+        """/healthz payload: slot occupancy + admission-queue state
+        (what the serve router's readiness and autoscale signals read).
+        Burning SLO objectives surface via the monitor's own health
+        source; here they only annotate the engine's view."""
+        return {
+            "healthy": True,
+            "slots_busy": sum(s is not None for s in self._slots),
+            "num_slots": self.num_slots,
+            "queue_depth": len(self.queue),
+            "queue_capacity": self.queue.capacity,
+            "results_pending": len(self.results),
+            "decode_steps": self.num_decode_steps,
+            "prefills": self.num_prefills,
+            "write_index": self.cache.write_index,
+            "max_seq_len": self.max_seq_len,
+            "slo_burning": sorted(self._slo_burning),
+        }
+
+    def attach_slo(self, monitor) -> None:
+        """Subscribe this engine's admission path to a
+        ``tpudl.obs.slo.SloMonitor``: the engine feeds the monitor its
+        TTFT/queue-wait/TPOT observations, and while any objective
+        burns, queued-but-unseated requests are shed
+        (``finish_reason="shed_slo"``) instead of being served into a
+        blown objective — the ROADMAP-2 shed/autoscale signal.
+
+        The subscription holds a WEAK engine reference: a monitor
+        outliving its engine (the router's long-lived monitor across
+        engine generations) must not pin each dead engine's KV cache
+        through its callback list."""
+        import weakref
+
+        self_ref = weakref.ref(self)
+
+        def _on_transition(objective, state):
+            eng = self_ref()
+            if eng is None:
+                return
+            if state["burning"]:
+                eng._slo_burning = eng._slo_burning | {objective.name}
+            else:
+                eng._slo_burning = eng._slo_burning - {objective.name}
+            registry().gauge("slo_burning").set(len(eng._slo_burning))
+
+        self._slo = monitor
+        monitor.subscribe(_on_transition)
+        monitor.evaluate()
+
+    def _slo_observe(self, metric: str, value: float) -> None:
+        if self._slo is not None:
+            self._slo.observe(metric, value)
 
     # -- admission / seating -------------------------------------------
 
     def _record_shed(self, entries: List[_Entry], reason: str) -> None:
         reg = registry()
+        rec = active_recorder()
         now = self.clock()
         for entry in entries:
             req = entry.request
+            wait = now - entry.submitted_at
             self.results[req.request_id] = Result(
                 request_id=req.request_id,
                 tokens=[],
                 finish_reason=reason,
-                queue_wait_s=now - entry.submitted_at,
+                queue_wait_s=wait,
             )
             reg.counter(f"serve_requests_{reason}").inc()
+            if rec is not None:
+                rec.event(
+                    "request_complete", CAT_SERVE_REQUEST,
+                    request_id=req.request_id, finish_reason=reason,
+                    queue_wait_s=wait, num_tokens=0,
+                )
 
     def _seat(self, entry: _Entry, slot: int) -> None:
         """Prefill one request (batch-1 program) and scatter it into
@@ -193,16 +279,21 @@ class Engine:
         first = int(np.asarray(sel)[0])
         self.cache.insert(row_cache, slot)
         now = self.clock()
+        queue_wait_ms = 1e3 * (t0 - entry.submitted_at)
+        ttft_ms = 1e3 * (now - entry.submitted_at)
         if rec is not None:
+            # request_id on the prefill span is the trace link between
+            # the queued event and this request's decode chunks.
             rec.record("prefill", CAT_SERVE_PREFILL, t0, now - t0,
-                       {"slot": slot})
+                       {"slot": slot, "request_id": req.request_id,
+                        "queue_wait_s": t0 - entry.submitted_at})
         self.num_prefills += 1
         reg = registry()
         reg.counter("serve_prefills").inc()
-        reg.histogram("serve_queue_wait_ms").observe(
-            1e3 * (t0 - entry.submitted_at)
-        )
-        reg.histogram("serve_ttft_ms").observe(1e3 * (now - entry.submitted_at))
+        reg.histogram("serve_queue_wait_ms").observe(queue_wait_ms)
+        reg.histogram("serve_ttft_ms").observe(ttft_ms)
+        self._slo_observe("serve_queue_wait_ms", queue_wait_ms)
+        self._slo_observe("serve_ttft_ms", ttft_ms)
         self._slots[slot] = _Slot(entry, first, ids.shape[0], t0, now)
         # A request can finish on its very first token.
         self._maybe_finish(slot, first)
@@ -214,6 +305,15 @@ class Engine:
         """Seat queued work into empty slots. Static mode only refills
         once the WHOLE batch drained (the run-to-completion baseline);
         continuous mode refills the moment a slot frees."""
+        if self._slo is not None:
+            # Drive burn-state transitions from the engine's own thread
+            # (the subscriber flips _slo_burning synchronously), then
+            # shed: while an objective burns, queued work would only be
+            # served into a blown objective — hand it back now so the
+            # client can retry elsewhere (the ROADMAP-2 router's cue).
+            self._slo.evaluate()
+            if self._slo_burning and len(self.queue):
+                self._record_shed(self.queue.drain_all(), "shed_slo")
         if not self.continuous and self._active():
             return
         if not self._active() and len(self.queue):
@@ -261,22 +361,36 @@ class Engine:
         req = s.request
         n = len(s.tokens)
         tpot = (s.t_last - s.t_first) / (n - 1) if n > 1 else None
+        ttft = s.t_first - s.entry.submitted_at
+        queue_wait = s.t_seated - s.entry.submitted_at
         self.results[req.request_id] = Result(
             request_id=req.request_id,
             tokens=list(s.tokens),
             finish_reason=reason,
-            ttft_s=s.t_first - s.entry.submitted_at,
+            ttft_s=ttft,
             tpot_s=tpot,
             # Queue wait ends at SEATING (pop), not first token — TTFT
             # additionally carries the prefill (and, for the session's
             # first request, compilation); matches serve_queue_wait_ms.
-            queue_wait_s=s.t_seated - s.entry.submitted_at,
+            queue_wait_s=queue_wait,
         )
         reg = registry()
         reg.counter("serve_requests_completed").inc()
         reg.counter("serve_tokens_generated").inc(n)
         if tpot is not None:
             reg.histogram("serve_tpot_ms").observe(1e3 * tpot)
+            self._slo_observe("serve_tpot_ms", 1e3 * tpot)
+        rec = active_recorder()
+        if rec is not None:
+            # Completion closes the per-request trace with the measured
+            # aggregates report.py --request checks the stitched
+            # timeline against.
+            rec.event(
+                "request_complete", CAT_SERVE_REQUEST,
+                request_id=req.request_id, finish_reason=reason,
+                ttft_s=ttft, tpot_s=tpot, queue_wait_s=queue_wait,
+                generation_s=s.t_last - s.t_first, num_tokens=n,
+            )
         self.cache.free(slot)
         self._slots[slot] = None
 
@@ -313,8 +427,13 @@ class Engine:
         self.cache.advance_write_index()  # host mirror of the +1 in-graph
         now = self.clock()
         if rec is not None:
+            # "rids" names every request this decode chunk advanced —
+            # the per-request trace's decode leg (report.py --request
+            # selects the chunks containing its id).
             rec.record("decode_step", CAT_SERVE_DECODE, t0, now - t0,
-                       {"busy": int(sum(s is not None for s in self._slots))})
+                       {"busy": int(sum(s is not None for s in self._slots)),
+                        "rids": [s.request.request_id
+                                 for s in self._slots if s is not None]})
         self.num_decode_steps += 1
         registry().counter("serve_decode_steps").inc()
         for i, s in enumerate(self._slots):

@@ -34,6 +34,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Tuple
 
+from tpudl.obs.spans import active_recorder
+
+#: Request-lifecycle event/span category (admission -> prefill ->
+#: decode chunks -> completion, stitched by ``report.py --request``).
+CAT_SERVE_REQUEST = "serve_request"
+
 
 @dataclass(order=True)
 class _Entry:
@@ -89,6 +95,18 @@ class AdmissionQueue:
                 submitted_at=now,
             ),
         )
+        rec = active_recorder()
+        if rec is not None:
+            # Admission is where a request's trace begins: the queued
+            # event anchors the queue-wait leg of the per-request
+            # timeline (report.py --request).
+            rec.event(
+                "request_queued", CAT_SERVE_REQUEST,
+                request_id=getattr(request, "request_id", None),
+                req_priority=priority,
+                deadline_s=deadline_s,
+                depth=len(self._heap),
+            )
         return True
 
     def pop(
@@ -118,6 +136,14 @@ class AdmissionQueue:
         for entry in skipped:
             heapq.heappush(self._heap, entry)
         return picked, shed
+
+    def drain_all(self) -> List[_Entry]:
+        """Hand back EVERY queued entry in scheduling order, emptying
+        the queue — the engine's SLO-burn shed path (served-in-flight
+        requests are untouched; only waiting work is returned)."""
+        out = sorted(self._heap)
+        self._heap = []
+        return out
 
     def drain_expired(self) -> List[_Entry]:
         """Shed every expired entry without popping work (the engine's

@@ -811,6 +811,18 @@ def fit(
         h_mwait = reg.histogram("metric_wait_s") if fetcher else None
         clock = rec.clock
 
+    # Live telemetry (tpudl.obs.exporter): with TPUDL_OBS_PORT set the
+    # process serves /metrics | /healthz | /snapshot while fit runs;
+    # the train_loop heartbeat beats once per dispatch so a hung loop
+    # (stuck iterator, wedged collective) reads as a growing
+    # heartbeat age on /healthz instead of silence. The beat itself is
+    # a lock + two stores — noise against a compiled-step dispatch.
+    from tpudl.obs import exporter as obs_exporter
+
+    obs_exporter.maybe_start_from_env()
+    heartbeat = obs_exporter.Heartbeat("train_loop")
+    g_last_step = obs_counters.registry().gauge("train_last_step")
+
     metrics = None          # last dispatch's DEVICE metrics tree
     metrics_count = 1       # 1 (scalar leaves) or K ([K]-stacked leaves)
     host_metrics_last = None  # last host dict the async drain delivered
@@ -994,6 +1006,8 @@ def fit(
                             h_step.observe((t1 - t0) / K)
                 metrics_count = K
                 dispatches += 1
+                heartbeat.beat(step=i + K)
+                g_last_step.set(start_step + n + K)
                 if profiling and prof_stop <= i + K:
                     jax.block_until_ready(metrics)
                     jax.profiler.stop_trace()
@@ -1074,6 +1088,8 @@ def fit(
                     h_step.observe(t1 - t0)
             metrics_count = 1
             dispatches += 1
+            heartbeat.beat(step=i + 1)
+            g_last_step.set(start_step + n + 1)
             if profiling and i + 1 >= prof_stop:
                 jax.block_until_ready(metrics)
                 jax.profiler.stop_trace()
@@ -1094,6 +1110,9 @@ def fit(
                 _log_line(i + 1, _to_host_metrics(metrics))
             i += 1
     finally:
+        # Orderly exit (or unwind) is "finished", not "hung": a stopped
+        # heartbeat is never stale on /healthz.
+        heartbeat.stop()
         if profiling:
             jax.profiler.stop_trace()
         if fetcher is not None:

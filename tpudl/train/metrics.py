@@ -168,6 +168,26 @@ class MetricFetcher:
             target=self._run, name="tpudl-metric-fetcher", daemon=True
         )
         self._thread.start()
+        # Live health: the sticky worker error is exactly the failure
+        # mode an operator cannot see from outside (the loop keeps
+        # dispatching until its next submit raises) — surface it on
+        # /healthz the moment the worker dies. Latest fetcher wins the
+        # name; its error stays visible even after close().
+        from tpudl.obs import exporter as obs_exporter
+
+        obs_exporter.register_health_source("metric_fetcher", self.health)
+
+    def health(self) -> dict:
+        with self._lock:
+            err = self._error
+            return {
+                "healthy": err is None,
+                "error": f"{type(err).__name__}: {err}"
+                if err is not None
+                else None,
+                "outstanding": self._outstanding,
+                "closed": self._closed,
+            }
 
     # -- consumer side (the train loop's thread) -----------------------
 
