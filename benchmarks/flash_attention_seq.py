@@ -1,33 +1,147 @@
-"""Scratch: flash vs reference attention across sequence lengths (fwd+bwd)."""
-import pathlib as _pathlib, sys as _sys
+"""Per-kernel attention microbench: flash vs reference across sequence
+lengths, fwd-only and fwd+bwd, with the FLOPs and bytes-moved model
+printed next to measured time.
+
+Promoted from the round-3 scratch sweep into the per-kernel companion of
+benchmarks/bert_attn_seq128.py (which measures whole-model steps): this
+isolates the attention op so a kernel regression is attributable before
+it shows up in model MFU. The bytes model is the reason flash wins long
+sequences — the reference einsum writes the [B, H, S, S] probability
+tensor to HBM both ways while flash streams K/V tiles through VMEM —
+and the printed ratio says how much headroom the measured speedup
+captured.
+
+Run (TPU): python benchmarks/flash_attention_seq.py --seqs 256,512,1024,2048
+Off-TPU the kernel runs in interpret mode (orders of magnitude slower —
+use tiny --seqs for plumbing checks only).
+"""
+
+import pathlib as _pathlib
+import sys as _sys
+
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
 
-import sys, time
-import jax, jax.numpy as jnp
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
 from tpudl.ops.attention import dot_product_attention
 from tpudl.ops.flash_attention import flash_attention
 
-B, H, D = 4, 12, 64
-for S in (int(x) for x in sys.argv[1].split(",")):
-    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.bfloat16)
-    k = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.bfloat16)
-    v = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.bfloat16)
+WARMUP = 3
+MEASURE = 20
 
-    for name, fn in (("reference", dot_product_attention), ("flash", flash_attention)):
-        def loss(q, k, v, fn=fn):
-            return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+def attn_flops(b, h, s, d, bwd):
+    """Matmul FLOPs: 2 fwd matmuls (QK^T, PV), 5 bwd-equivalent; each
+    2*B*H*S*S*D multiply-adds."""
+    per_matmul = 2 * b * h * s * s * d
+    return per_matmul * (2 + (5 if bwd else 0))
+
+
+def attn_bytes(b, h, s, d, itemsize, bwd, flash):
+    """Idealized HBM traffic. Reference materializes [B,H,S,S] logits
+    (f32) + probabilities (input dtype) each direction; flash moves only
+    the [B,S,H,D] operands (+lse rows)."""
+    qkv = 3 * b * s * h * d * itemsize
+    out = b * s * h * d * itemsize
+    probs = b * h * s * s * (4 + itemsize)  # f32 logits + cast weights
+    if flash:
+        fwd = qkv + out + b * h * s * 4  # + lse
+        return fwd * (3 if bwd else 1)  # bwd re-reads operands ~2x
+    fwd = qkv + out + 2 * probs  # write + read back
+    return fwd * (3 if bwd else 1)
+
+
+def bench(name, fn, args, bwd):
+    if bwd:
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
 
         step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        try:
-            g = step(q, k, v)
-            float(jnp.sum(g[0].astype(jnp.float32))[None][0])
-            t0 = time.perf_counter(); N = 20
-            for _ in range(N):
-                g = step(q, k, v)
-            float(jnp.sum(g[0].astype(jnp.float32))[None][0])
-            dt = (time.perf_counter() - t0) / N
-            # fwd+bwd attention flops ~ 4 * (2*B*H*S^2*D) fwd-equivalent matmuls
-            flops = 4 * 2 * 2 * B * H * S * S * D
-            print(f"S={S:5d} {name:9s}: {dt*1e3:8.2f} ms  {flops/dt/1e12:6.2f} TFLOP/s", flush=True)
-        except Exception as e:
-            print(f"S={S:5d} {name:9s}: FAILED {type(e).__name__}: {str(e)[:120]}", flush=True)
+
+        def run():
+            g = step(*args)
+            jnp.sum(g[0].astype(jnp.float32)).block_until_ready()
+    else:
+        step = jax.jit(fn)
+
+        def run():
+            step(*args).block_until_ready()
+
+    try:
+        run()  # compile
+        for _ in range(WARMUP):
+            run()
+        t0 = time.perf_counter()
+        for _ in range(MEASURE):
+            run()
+        return (time.perf_counter() - t0) / MEASURE
+    except Exception as e:  # pragma: no cover - report-and-continue
+        print(f"  {name}: FAILED {type(e).__name__}: {str(e)[:100]}",
+              flush=True)
+        return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--seqs", default="256,512,1024,2048",
+                    help="comma-separated sequence lengths")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--causal", action="store_true")
+    args = ap.parse_args(argv)
+
+    b, h, d = args.batch, args.heads, args.head_dim
+    dtype = jnp.dtype(args.dtype)
+    impls = [
+        ("reference", lambda q, k, v: dot_product_attention(q, k, v)),
+        ("flash", lambda q, k, v: flash_attention(q, k, v,
+                                                  causal=args.causal)),
+    ]
+    if args.causal:
+        from tpudl.ops.attention import causal_mask
+
+        impls[0] = (
+            "reference",
+            lambda q, k, v: dot_product_attention(
+                q, k, v, mask=causal_mask(q.shape[1], k.shape[1])
+            ),
+        )
+
+    print(f"attention microbench: B={b} H={h} D={d} dtype={args.dtype} "
+          f"causal={args.causal} (warmup {WARMUP}, measure {MEASURE})")
+    print(f"{'seq':>6} {'pass':>8} {'impl':>10} {'ms':>9} {'TFLOP/s':>8} "
+          f"{'model GB':>9} {'GB/s':>8}")
+    for s in (int(x) for x in args.seqs.split(",")):
+        q = jax.random.normal(jax.random.key(0), (b, s, h, d), dtype)
+        k = jax.random.normal(jax.random.key(1), (b, s, h, d), dtype)
+        v = jax.random.normal(jax.random.key(2), (b, s, h, d), dtype)
+        for bwd in (False, True):
+            times = {}
+            for name, fn in impls:
+                dt = bench(name, fn, (q, k, v), bwd)
+                times[name] = dt
+                if dt is None:
+                    continue
+                fl = attn_flops(b, h, s, d, bwd)
+                by = attn_bytes(b, h, s, d, dtype.itemsize, bwd,
+                                flash=name == "flash")
+                print(f"{s:>6} {'fwd+bwd' if bwd else 'fwd':>8} "
+                      f"{name:>10} {dt * 1e3:>9.2f} "
+                      f"{fl / dt / 1e12:>8.2f} {by / 1e9:>9.3f} "
+                      f"{by / dt / 1e9:>8.1f}", flush=True)
+            if times.get("reference") and times.get("flash"):
+                print(f"{'':>6} {'':>8} {'speedup':>10} "
+                      f"{times['reference'] / times['flash']:>9.2f}x")
+
+
+if __name__ == "__main__":
+    main()

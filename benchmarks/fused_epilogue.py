@@ -1,0 +1,192 @@
+"""Fused-epilogue kernel microbench: the three kernel families (norms,
+MLP epilogues, cross-entropy) fused vs XLA-composite, fwd and fwd+bwd,
+with the bytes-moved model printed next to measured time.
+
+These are the memory-bound ops pinning BERT-base at ~0.527 MFU
+(BENCH_r03-r05): each composite epilogue is extra full HBM round-trips
+over the activation, so the idealized bytes ratio is the speedup
+ceiling — the printed model says how much of it the kernel captured.
+Shapes default to the BERT-base seq-128/batch-256 regime (the headline
+config) plus the Llama-vocab cross-entropy case where the fused loss
+matters most.
+
+Run (TPU): python benchmarks/fused_epilogue.py
+Off-TPU the fused path runs in Pallas interpret mode (orders of
+magnitude slower); --smoke shrinks shapes so the plumbing stays
+checkable in the hermetic container.
+"""
+
+import pathlib as _pathlib
+import sys as _sys
+
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+WARMUP = 3
+MEASURE = 20
+
+
+def _time(run):
+    run()  # compile
+    for _ in range(WARMUP):
+        run()
+    t0 = time.perf_counter()
+    for _ in range(MEASURE):
+        run()
+    return (time.perf_counter() - t0) / MEASURE
+
+
+def bench_case(name, make_fn, arg_arrays, bytes_fused, bytes_ref):
+    """One kernel family at one shape: fused vs reference, fwd and
+    fwd+bwd; prints ms, the idealized bytes model, and achieved GB/s."""
+    rows = []
+    for bwd in (False, True):
+        times = {}
+        for impl in ("reference", "fused"):
+            fn = make_fn(impl)
+            if bwd:
+                grad = jax.jit(jax.grad(
+                    lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2),
+                    argnums=tuple(range(len(arg_arrays))),
+                ))
+
+                def run():
+                    g = grad(*arg_arrays)
+                    jnp.sum(g[0].astype(jnp.float32)).block_until_ready()
+            else:
+                jit_fn = jax.jit(fn)
+
+                def run():
+                    jax.tree.leaves(jit_fn(*arg_arrays))[0].block_until_ready()
+            try:
+                times[impl] = _time(run)
+            except Exception as e:  # pragma: no cover
+                print(f"  {name} {impl}: FAILED {type(e).__name__}: "
+                      f"{str(e)[:100]}", flush=True)
+                times[impl] = None
+        mult = 3.0 if bwd else 1.0  # bwd re-traverses the streams ~2x
+        for impl, model_bytes in (("reference", bytes_ref * mult),
+                                  ("fused", bytes_fused * mult)):
+            dt = times[impl]
+            if dt is None:
+                continue
+            print(f"{name:>24} {'fwd+bwd' if bwd else 'fwd':>8} "
+                  f"{impl:>10} {dt * 1e3:>9.3f} ms  "
+                  f"model {model_bytes / 1e9:>7.3f} GB  "
+                  f"{model_bytes / dt / 1e9:>7.1f} GB/s", flush=True)
+        if times.get("reference") and times.get("fused"):
+            ratio = times["reference"] / times["fused"]
+            ceiling = bytes_ref / bytes_fused
+            print(f"{'':>24} {'':>8} {'speedup':>10} {ratio:>9.2f}x  "
+                  f"(bytes ceiling {ceiling:.2f}x)", flush=True)
+        rows.append(times)
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=None,
+                    help="activation rows (default: 256*128 = the "
+                    "BERT-base headline batch*seq)")
+    ap.add_argument("--hidden", type=int, default=768)
+    ap.add_argument("--intermediate", type=int, default=3072)
+    ap.add_argument("--vocab", type=int, default=30_522)
+    ap.add_argument("--ce-rows", type=int, default=None,
+                    help="cross-entropy rows (default 4096)")
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for off-TPU plumbing checks")
+    args = ap.parse_args(argv)
+
+    from tpudl.ops.cross_entropy import (
+        softmax_cross_entropy,
+        softmax_cross_entropy_ref,
+    )
+    from tpudl.ops.mlp_fused import bias_gelu, swiglu
+    from tpudl.ops.norms import layer_norm, rms_norm
+
+    n = args.rows if args.rows is not None else (256 if args.smoke else
+                                                 256 * 128)
+    h = 128 if args.smoke else args.hidden
+    f = 256 if args.smoke else args.intermediate
+    ce_n = 64 if args.smoke else (args.ce_rows or 4096)
+    v = 512 if args.smoke else args.vocab
+    dtype = jnp.dtype(args.dtype)
+    it = dtype.itemsize
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (n, h), dtype)
+    r = jax.random.normal(jax.random.key(1), (n, h), dtype)
+    scale = jnp.ones((h,))
+    bias = jnp.zeros((h,))
+    xf = jax.random.normal(jax.random.key(2), (n, f), dtype)
+    uf = jax.random.normal(jax.random.key(3), (n, f), dtype)
+    bf = jnp.zeros((f,))
+    logits = jax.random.normal(jax.random.key(4), (ce_n, v),
+                               jnp.float32) * 3
+    labels = jax.random.randint(jax.random.key(5), (ce_n,), 0, v)
+
+    print(f"fused epilogue microbench: rows={n} hidden={h} "
+          f"intermediate={f} ce=[{ce_n}, {v}] dtype={args.dtype} "
+          f"(warmup {WARMUP}, measure {MEASURE}; bytes model is "
+          f"idealized HBM traffic — the speedup ceiling)")
+
+    nh = n * h * it
+    # LayerNorm+residual composite: read x+r, write sum, read sum,
+    # write normed (f32 stats fuse); fused: read x+r, write normed
+    # (+128-lane stats, negligible).
+    bench_case(
+        "layer_norm+residual",
+        lambda impl: functools.partial(
+            layer_norm, impl=impl, return_sum=False
+        ),
+        (x, scale, bias, r),
+        bytes_fused=3 * nh, bytes_ref=5 * nh,
+    )
+    bench_case(
+        "rms_norm+residual(sum)",
+        lambda impl: (lambda *a: rms_norm(*a, impl=impl)[0]),
+        (x, scale, r),
+        bytes_fused=4 * nh, bytes_ref=5 * nh,
+    )
+    nf = n * f * it
+    # bias+gelu composite: read u, write u+b, read, write gelu; fused:
+    # read u, write y.
+    bench_case(
+        "bias_gelu",
+        lambda impl: functools.partial(bias_gelu, impl=impl),
+        (xf, bf),
+        bytes_fused=2 * nf, bytes_ref=4 * nf,
+    )
+    # swiglu composite: read gate, write silu, read silu+up, write y;
+    # fused: read gate+up, write y.
+    bench_case(
+        "swiglu",
+        lambda impl: functools.partial(swiglu, impl=impl),
+        (uf, xf),
+        bytes_fused=3 * nf, bytes_ref=5 * nf,
+    )
+    bv = ce_n * v * 4
+    # cross-entropy composite: read logits, write+read log-probs
+    # ([B, V] materialized); fused: read logits once.
+    bench_case(
+        "cross_entropy",
+        lambda impl: (
+            (lambda z: softmax_cross_entropy(z, labels, impl="fused"))
+            if impl == "fused"
+            else (lambda z: softmax_cross_entropy_ref(z, labels))
+        ),
+        (logits,),
+        bytes_fused=1 * bv, bytes_ref=3 * bv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,76 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# TPU-only tests and the environment-failure guard.
+#
+# Two hermetic-tier rules:
+#
+# 1. Tests that REQUIRE real TPU hardware (compiled Pallas kernels,
+#    hardware-PRNG dropout draws) carry @pytest.mark.tpu and SKIP here
+#    with a clear reason instead of failing — they run on the driver's
+#    TPU environment.
+# 2. The known environment-failure bucket (this CPU jaxlib cannot run
+#    cross-process computations — "Multiprocess computations aren't
+#    implemented on the CPU backend") is pinned by nodeid below. Any
+#    NEW test failing with that signature is flagged loudly at session
+#    end: it should either use the spawn-free fake-mesh idiom or carry
+#    the marker, not silently grow the bucket.
+# ---------------------------------------------------------------------------
+
+_ENV_FAILURE_SIGNATURE = "Multiprocess computations aren't implemented"
+#: Non-slow tests known to hit the CPU-jaxlib multiprocess limitation at
+#: HEAD (the `slow`-marked spawn tests are deselected from tier-1 and
+#: tracked in CHANGES.md PR 4 instead).
+_KNOWN_ENV_FAILURES = frozenset({
+    "tests/test_graft_entry.py::test_dryrun_multichip_8",
+})
+_new_env_failures = []
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() in ("tpu", "axon"):
+        return
+    skip = pytest.mark.skip(
+        reason="requires real TPU hardware (compiled Pallas kernels / "
+        "hardware PRNG); the CPU tier runs the interpret-mode parity "
+        "suite instead"
+    )
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if (
+        report.failed
+        and call.excinfo is not None
+        and _ENV_FAILURE_SIGNATURE in repr(call.excinfo.value)
+        and item.nodeid not in _KNOWN_ENV_FAILURES
+    ):
+        _new_env_failures.append(item.nodeid)
+
+
+def pytest_terminal_summary(terminalreporter):
+    if _new_env_failures:
+        terminalreporter.section(
+            "NEW environment-limited failures", sep="!"
+        )
+        terminalreporter.write_line(
+            "These tests failed with the known CPU-backend multiprocess "
+            "limitation but are NOT in conftest._KNOWN_ENV_FAILURES:"
+        )
+        for nodeid in _new_env_failures:
+            terminalreporter.write_line(f"  {nodeid}")
+        terminalreporter.write_line(
+            "Do not grow the environment-failure bucket: use the fake "
+            "8-device CPU mesh (no process spawn) or mark the test "
+            "@pytest.mark.tpu / @pytest.mark.slow."
+        )
+
 
 @pytest.fixture(scope="session")
 def mesh8():

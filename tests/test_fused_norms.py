@@ -1,0 +1,181 @@
+"""Fused LayerNorm/RMSNorm kernel parity vs the XLA composites.
+
+Runs the actual Pallas kernels in interpreter mode on the CPU backend
+(the hermetic tier — same code compiles on TPU). Coverage: fwd + grads,
+with/without the fused residual add, odd (non-tile-multiple) shapes,
+bf16-compute tolerance, and the summed-output cotangent path (the
+pre-norm residual carry)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudl.ops.norms import (
+    layer_norm,
+    layer_norm_ref,
+    rms_norm,
+    rms_norm_ref,
+)
+
+
+def _arrs(rng, n=37, h=100, dtype=jnp.float32):
+    x = jnp.asarray(rng.normal(size=(n, h)), dtype)
+    r = jnp.asarray(rng.normal(size=(n, h)), dtype)
+    scale = jnp.asarray(rng.normal(size=(h,)) * 0.5 + 1.0, jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(h,)) * 0.1, jnp.float32)
+    return x, r, scale, bias
+
+
+@pytest.mark.parametrize("n,h", [(37, 100), (16, 128), (130, 257)])
+def test_layer_norm_forward_parity(rng_np, n, h):
+    x, r, scale, bias = _arrs(rng_np, n, h)
+    out = layer_norm(x, scale, bias, impl="fused")
+    ref = layer_norm_ref(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_residual_forward_parity(rng_np):
+    x, r, scale, bias = _arrs(rng_np)
+    y, s = layer_norm(x, scale, bias, r, impl="fused")
+    yr, sr = layer_norm_ref(x, scale, bias, r)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(sr),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_layer_norm_return_sum_false(rng_np):
+    """The post-norm form (BERT) skips the summed output but must norm
+    the same value."""
+    x, r, scale, bias = _arrs(rng_np)
+    y = layer_norm(x, scale, bias, r, return_sum=False, impl="fused")
+    yr, _ = layer_norm_ref(x, scale, bias, r)
+    assert not isinstance(y, tuple)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_layer_norm_gradient_parity(rng_np):
+    x, r, scale, bias = _arrs(rng_np)
+
+    def loss(fn):
+        def f(x, scale, bias, r):
+            y, s = fn(x, scale, bias, r)
+            # Use BOTH outputs so the summed-output cotangent (gs) path
+            # is exercised, with different weights to catch a swap.
+            return jnp.sum(y * y) + jnp.sum(jnp.sin(s))
+        return f
+
+    gf = jax.grad(loss(lambda *a: layer_norm(*a, impl="fused")),
+                  argnums=(0, 1, 2, 3))(x, scale, bias, r)
+    gr = jax.grad(loss(lambda *a: layer_norm_ref(*a)),
+                  argnums=(0, 1, 2, 3))(x, scale, bias, r)
+    for name, a, b in zip(("dx", "dscale", "dbias", "dres"), gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"{name} mismatch",
+        )
+
+
+def test_layer_norm_gradient_parity_no_residual(rng_np):
+    x, _, scale, bias = _arrs(rng_np, n=24, h=96)
+
+    def mk(fn):
+        return lambda x, s, b: jnp.sum(fn(x, s, b) ** 2)
+
+    gf = jax.grad(mk(lambda *a: layer_norm(*a, impl="fused")),
+                  argnums=(0, 1, 2))(x, scale, bias)
+    gr = jax.grad(mk(lambda *a: layer_norm_ref(*a)),
+                  argnums=(0, 1, 2))(x, scale, bias)
+    for name, a, b in zip(("dx", "dscale", "dbias"), gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"{name} mismatch",
+        )
+
+
+@pytest.mark.parametrize("n,h", [(37, 100), (16, 128), (64, 384)])
+def test_rms_norm_forward_parity(rng_np, n, h):
+    x, r, scale, _ = _arrs(rng_np, n, h)
+    out = rms_norm(x, scale, impl="fused")
+    ref = rms_norm_ref(x, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_residual_gradient_parity(rng_np):
+    x, r, scale, _ = _arrs(rng_np)
+
+    def loss(fn):
+        def f(x, scale, r):
+            y, s = fn(x, scale, r)
+            return jnp.sum(y * y) + jnp.sum(jnp.sin(s))
+        return f
+
+    gf = jax.grad(loss(lambda *a: rms_norm(*a, impl="fused")),
+                  argnums=(0, 1, 2))(x, scale, r)
+    gr = jax.grad(loss(lambda *a: rms_norm_ref(*a)),
+                  argnums=(0, 1, 2))(x, scale, r)
+    for name, a, b in zip(("dx", "dscale", "dres"), gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"{name} mismatch",
+        )
+
+
+def test_rms_norm_gradient_parity_no_residual(rng_np):
+    x, _, scale, _ = _arrs(rng_np, n=24, h=96)
+    gf = jax.grad(
+        lambda x, s: jnp.sum(rms_norm(x, s, impl="fused") ** 2),
+        argnums=(0, 1),
+    )(x, scale)
+    gr = jax.grad(
+        lambda x, s: jnp.sum(rms_norm_ref(x, s) ** 2), argnums=(0, 1)
+    )(x, scale)
+    for name, a, b in zip(("dx", "dscale"), gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4,
+            err_msg=f"{name} mismatch",
+        )
+
+
+def test_bf16_compute_tolerance(rng_np):
+    """bf16 activations: fused keeps f32 statistics like the composite;
+    outputs agree at bf16 resolution and keep the input dtype."""
+    x, r, scale, bias = _arrs(rng_np, dtype=jnp.bfloat16)
+    y, s = layer_norm(x, scale, bias, r, impl="fused")
+    yr, sr = layer_norm_ref(x, scale, bias, r)
+    assert y.dtype == jnp.bfloat16 and s.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(yr, np.float32),
+        rtol=0.05, atol=0.05,
+    )
+    z = rms_norm(x, scale, impl="fused")
+    zr = rms_norm_ref(x, scale)
+    assert z.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(z, np.float32), np.asarray(zr, np.float32),
+        rtol=0.05, atol=0.05,
+    )
+
+
+def test_3d_inputs_and_auto_cpu_fallback(rng_np):
+    """[B, S, H] inputs flatten/unflatten transparently, and impl='auto'
+    off-TPU is BITWISE the reference composite (the model-flag fallback
+    contract)."""
+    x = jnp.asarray(rng_np.normal(size=(2, 9, 100)), jnp.float32)
+    scale = jnp.ones((100,))
+    bias = jnp.zeros((100,))
+    fused = layer_norm(x, scale, bias, impl="fused")
+    assert fused.shape == x.shape
+    auto = layer_norm(x, scale, bias, impl="auto")
+    ref = layer_norm_ref(x, scale, bias)
+    assert (np.asarray(auto) == np.asarray(ref)).all()
+
+
+def test_bad_impl_rejected(rng_np):
+    x = jnp.ones((8, 32))
+    with pytest.raises(ValueError, match="impl"):
+        rms_norm(x, jnp.ones((32,)), impl="pallas")

@@ -66,6 +66,14 @@ class BertConfig:
     #: keeps MXU outputs and recomputes only elementwise/softmax work,
     #: a middle ground between full remat and none. None = save nothing.
     remat_policy: Optional[str] = None
+    #: Fused-epilogue kernel tier (tpudl.ops.norms / mlp_fused): False
+    #: (default) = the original composite path, bit-identical to before
+    #: the tier existed; True = Pallas fused LayerNorm(+residual) and
+    #: bias+GeLU on TPU, composite off-TPU (what bench flips on as a
+    #: measured variant); "force" = Pallas everywhere (interpret mode
+    #: off-TPU — the CPU parity-test mode). Param tree is identical in
+    #: all modes, so checkpoints and HF imports are interchangeable.
+    fused_ops: Any = False
 
     @property
     def head_dim(self) -> int:
@@ -88,6 +96,55 @@ def _dense(cfg: BertConfig, features: int, name: str) -> nn.Dense:
     )
 
 
+class FusedLayerNorm(nn.Module):
+    """LayerNorm(+optional residual-add) through the tpudl.ops.norms
+    seam. Param tree (scale/bias, f32, ones/zeros init) is identical to
+    ``nn.LayerNorm``, so fused and composite checkpoints interchange.
+    With ``residual`` returns ``(normed, x + residual)``."""
+
+    eps: float
+    impl: str
+
+    @nn.compact
+    def __call__(self, x, residual=None, return_sum=True):
+        from tpudl.ops.norms import layer_norm
+
+        h = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (h,))
+        bias = self.param("bias", nn.initializers.zeros, (h,))
+        return layer_norm(
+            x, scale, bias, residual, eps=self.eps, return_sum=return_sum,
+            impl=self.impl,
+        )
+
+
+class FusedBiasGeluDense(nn.Module):
+    """``nn.Dense`` + exact GeLU with the bias add fused into the GeLU
+    epilogue (tpudl.ops.mlp_fused.bias_gelu) — the matmul runs pre-bias
+    so the [N, 4H] stream is read/written once. Params (kernel/bias,
+    same init) are identical to the composite ``nn.Dense``."""
+
+    cfg: BertConfig
+    features: int
+    impl: str
+
+    @nn.compact
+    def __call__(self, x):
+        from tpudl.ops.mlp_fused import bias_gelu
+
+        cfg = self.cfg
+        kernel = self.param(
+            "kernel", nn.initializers.normal(0.02),
+            (x.shape[-1], self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        y = jax.lax.dot_general(
+            x.astype(cfg.dtype), kernel.astype(cfg.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+        )
+        return bias_gelu(y, bias, impl=self.impl)
+
+
 class BertEmbeddings(nn.Module):
     cfg: BertConfig
 
@@ -105,8 +162,16 @@ class BertEmbeddings(nn.Module):
                       embedding_init=nn.initializers.normal(0.02),
                       name="token_type_embeddings")(token_type_ids)
         x = we + pe + te
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
-                         name="layer_norm")(x)
+        if cfg.fused_ops:
+            from tpudl.ops.norms import fused_ops_impl
+
+            x = FusedLayerNorm(
+                cfg.layer_norm_eps, fused_ops_impl(cfg.fused_ops),
+                name="layer_norm",
+            )(x)
+        else:
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                             name="layer_norm")(x)
         x = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(x, deterministic=not train)
         return x.astype(cfg.dtype)
 
@@ -179,17 +244,43 @@ class BertLayer(nn.Module):
         attn_out = attn_cls(cfg, name="attention")(
             hidden, attn_mask, train
         )
-        hidden = nn.LayerNorm(
-            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="attention_norm"
-        )(hidden + attn_out).astype(cfg.dtype)
+        if cfg.fused_ops:
+            # Fused-epilogue path (tpudl.ops.norms / mlp_fused): the
+            # residual add rides inside the LayerNorm kernel, and BERT's
+            # post-norm blocks never consume the summed value, so the
+            # kernels skip that write (return_sum=False via the module's
+            # residual call returning only the normed value). Composite
+            # fallback off-TPU keeps these numerics (fused_ops_impl).
+            from tpudl.ops.norms import fused_ops_impl
 
-        inter = _dense(cfg, cfg.intermediate_size, "intermediate")(hidden)
-        inter = nn.gelu(inter, approximate=False)
-        out = _dense(cfg, cfg.hidden_size, "output")(inter)
-        out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(out, deterministic=not train)
-        hidden = nn.LayerNorm(
-            epsilon=cfg.layer_norm_eps, dtype=jnp.float32, name="output_norm"
-        )(hidden + out).astype(cfg.dtype)
+            impl = fused_ops_impl(cfg.fused_ops)
+            hidden = FusedLayerNorm(
+                cfg.layer_norm_eps, impl, name="attention_norm"
+            )(attn_out, hidden, return_sum=False).astype(cfg.dtype)
+            inter = FusedBiasGeluDense(
+                cfg, cfg.intermediate_size, impl, name="intermediate"
+            )(hidden)
+            out = _dense(cfg, cfg.hidden_size, "output")(inter)
+            out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(
+                out, deterministic=not train
+            )
+            hidden = FusedLayerNorm(
+                cfg.layer_norm_eps, impl, name="output_norm"
+            )(out, hidden, return_sum=False).astype(cfg.dtype)
+        else:
+            hidden = nn.LayerNorm(
+                epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                name="attention_norm"
+            )(hidden + attn_out).astype(cfg.dtype)
+
+            inter = _dense(cfg, cfg.intermediate_size, "intermediate")(hidden)
+            inter = nn.gelu(inter, approximate=False)
+            out = _dense(cfg, cfg.hidden_size, "output")(inter)
+            out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(out, deterministic=not train)
+            hidden = nn.LayerNorm(
+                epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                name="output_norm"
+            )(hidden + out).astype(cfg.dtype)
         hidden = constrain(hidden, ("dp", "fsdp"), "sp", "tp")
         return hidden
 

@@ -45,6 +45,14 @@ class LlamaConfig:
     remat: bool = False
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Fused-epilogue kernel tier (tpudl.ops.norms / mlp_fused): False
+    # (default) = composite RMSNorm/SwiGLU, bit-identical to before the
+    # tier; True = Pallas fused RMSNorm(+residual) and SwiGLU on TPU,
+    # composite off-TPU; "force" = Pallas everywhere (interpret mode
+    # off-TPU — the CPU parity-test mode). Same param tree either way.
+    # These ops run per serve decode step, so the fused path cuts decode
+    # TPOT alongside training step time.
+    fused_ops: Any = False
     # MoE (tpudl.ops.moe): >0 swaps the dense SwiGLU MLP for an
     # expert-parallel gated MoE in every block.
     moe_experts: int = 0
@@ -110,17 +118,24 @@ def _proj(cfg: LlamaConfig, features: int, name: str):
 
 
 class RMSNorm(nn.Module):
+    """RMS normalization through the tpudl.ops.norms seam. The default
+    ``impl="reference"`` is the original composite math verbatim
+    (rms_norm_ref); ``impl="auto"/"fused"`` routes to the Pallas fused
+    kernel, which also takes the residual add (``residual=`` returns
+    ``(normed, x + residual)`` — the pre-norm block's carried sum) in
+    the same activation pass."""
+
     eps: float = 1e-5
+    impl: str = "reference"
 
     @nn.compact
-    def __call__(self, x):
-        dtype = x.dtype
-        x32 = x.astype(jnp.float32)
+    def __call__(self, x, residual=None):
+        from tpudl.ops.norms import rms_norm
+
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
-        norm = x32 * jax.lax.rsqrt(
-            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        return rms_norm(
+            x, scale, residual, eps=self.eps, impl=self.impl
         )
-        return (norm * scale).astype(dtype)
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -262,14 +277,21 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, hidden, positions, kv_mask=None, decode: bool = False):
         cfg = self.cfg
+        from tpudl.ops.norms import fused_ops_impl
+
+        impl = fused_ops_impl(cfg.fused_ops)
         attn = LlamaAttention(cfg, name="attention")(
-            RMSNorm(cfg.rms_norm_eps, name="input_norm")(hidden),
+            RMSNorm(cfg.rms_norm_eps, impl, name="input_norm")(hidden),
             positions,
             kv_mask,
             decode,
         )
-        hidden = hidden + attn
-        x = RMSNorm(cfg.rms_norm_eps, name="post_attention_norm")(hidden)
+        # The attention residual add rides inside the post-attention
+        # norm kernel; the summed value comes back as the carried
+        # residual (one activation pass instead of add + norm).
+        x, hidden = RMSNorm(
+            cfg.rms_norm_eps, impl, name="post_attention_norm"
+        )(attn, residual=hidden)
         if cfg.moe_experts > 0:
             from tpudl.ops.moe import MoEMlp
 
@@ -284,9 +306,13 @@ class LlamaBlock(nn.Module):
                 name="moe",
             )(x)
         else:
+            from tpudl.ops.mlp_fused import swiglu
+
             gate = _proj(cfg, cfg.intermediate_size, "gate_proj")(x)
             up = _proj(cfg, cfg.intermediate_size, "up_proj")(x)
-            down = _proj(cfg, cfg.hidden_size, "down_proj")(nn.silu(gate) * up)
+            down = _proj(cfg, cfg.hidden_size, "down_proj")(
+                swiglu(gate, up, impl=impl)
+            )
         hidden = hidden + down
         return constrain(hidden, ("dp", "fsdp"), "sp", "tp")
 
@@ -325,7 +351,12 @@ class LlamaModel(nn.Module):
             block = nn.remat(LlamaBlock, static_argnums=(4,))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x, positions, kv_mask, decode)
-        return RMSNorm(cfg.rms_norm_eps, name="final_norm")(x)
+        from tpudl.ops.norms import fused_ops_impl
+
+        return RMSNorm(
+            cfg.rms_norm_eps, fused_ops_impl(cfg.fused_ops),
+            name="final_norm"
+        )(x)
 
 
 class LlamaForCausalLM(nn.Module):

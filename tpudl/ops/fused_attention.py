@@ -53,6 +53,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpudl.ops.attention import MASK_VALUE
 from tpudl.ops.pallas_utils import (
+    COMPILER_PARAMS,
     flat_cell_id,
     keep_mask,
     round_up as _round_up,
@@ -220,7 +221,7 @@ def _fused_fwd(q, k, v, kvmask, seed, causal, scale, rate, group, interpret,
             head_dim=d, group=group, has_kvmask=has_kvmask,
         ),
         grid=grid,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel")
         ),
         in_specs=[seed_spec, row, row, row, kvm_spec],
@@ -250,7 +251,7 @@ def _fused_bwd(causal, scale, rate, group, interpret, has_mask, res, g_out):
             head_dim=d, group=group, has_kvmask=has_kvmask,
         ),
         grid=grid,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel")
         ),
         in_specs=[seed_spec, row, row, row, kvm_spec, row],

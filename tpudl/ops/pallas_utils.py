@@ -18,6 +18,13 @@ def round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+#: jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams (~0.5);
+#: resolve whichever this jax ships so the kernels run on both.
+COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
+
 def seed_cell(seed_ref, cell) -> None:
     """Seed the TPU PRNG with a distinct stream per grid cell: prng_seed
     takes at most two 32-bit words, so the flattened cell id folds into

@@ -40,6 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpudl.ops.attention import MASK_VALUE
 from tpudl.ops.pallas_utils import (
+    COMPILER_PARAMS,
     flat_cell_id,
     keep_mask as _keep_mask_impl,
     round_up as _round_up,
@@ -140,7 +141,7 @@ def _specs(b, h, sq_p, skv_p, block_q, group):
                        memory_space=pltpu.VMEM)
     seed = pl.BlockSpec(memory_space=pltpu.SMEM)
     grid = (b, h // group, sq_p // block_q)
-    sem = pltpu.CompilerParams(
+    sem = COMPILER_PARAMS(
         dimension_semantics=("parallel", "parallel", "parallel")
     )
     return grid, seed, tile, kvm, sem

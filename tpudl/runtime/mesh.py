@@ -42,6 +42,24 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` on jax >= 0.5; falls back to the
+    ``jax.experimental.shard_map`` spelling (where ``check_vma`` was
+    named ``check_rep``) on older jaxlibs — the ONE compat seam for every
+    shard_map user (ring/ulysses attention, the pipeline schedules)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 AXIS_DATA = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_SEQ = "sp"

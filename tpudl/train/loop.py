@@ -103,15 +103,23 @@ def create_train_state(
 
 
 def cross_entropy_loss(
-    logits: jax.Array, labels: jax.Array, label_smoothing: float = 0.0
+    logits: jax.Array,
+    labels: jax.Array,
+    label_smoothing: float = 0.0,
+    impl: str = "reference",
 ) -> jax.Array:
-    if label_smoothing > 0.0:
-        num_classes = logits.shape[-1]
-        onehot = optax.smooth_labels(
-            jax.nn.one_hot(labels, num_classes), label_smoothing
-        )
-        return optax.softmax_cross_entropy(logits, onehot).mean()
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    """Mean cross-entropy through the tpudl.ops.cross_entropy seam.
+
+    ``impl="reference"`` (default) is the optax composite this function
+    always was; ``"fused"``/``"auto"`` stream the vocab axis through the
+    Pallas online-logsumexp kernel so the [B, V] softmax is never
+    materialized (the LM-vocab loss-step bandwidth fix — bench measures
+    it as the fused-ops variant before any default flips)."""
+    from tpudl.ops.cross_entropy import softmax_cross_entropy
+
+    return softmax_cross_entropy(
+        logits, labels, label_smoothing, impl=impl
+    ).mean()
 
 
 def make_classification_train_step(
@@ -122,8 +130,14 @@ def make_classification_train_step(
     accum_steps: int = 1,
     input_transform: Optional[Callable[[dict], dict]] = None,
     overlap_bucket_mb: Optional[float] = None,
+    loss_impl: str = "reference",
 ) -> Callable:
     """Train step for image/sequence classification models.
+
+    ``loss_impl`` routes the cross-entropy through the
+    tpudl.ops.cross_entropy dispatch seam ("reference" = the optax
+    composite, unchanged default; "auto"/"fused" = the Pallas fused
+    loss that never materializes the [B, V] softmax).
 
     `input_keys` name the batch columns passed positionally to the model —
     ("image",) for CV, ("input_ids", "attention_mask") for BERT-style.
@@ -218,7 +232,9 @@ def make_classification_train_step(
                 )
                 mutated = {}
                 new_stats = None
-            loss = cross_entropy_loss(outputs, batch[label_key], label_smoothing)
+            loss = cross_entropy_loss(
+                outputs, batch[label_key], label_smoothing, impl=loss_impl
+            )
             aux = None
             if moe_aux_weight > 0.0:
                 aux = _sown_aux(mutated)
@@ -298,8 +314,13 @@ def make_classification_eval_step(
     input_keys: "str | tuple" = ("image",),
     label_key: str = "label",
     input_transform: Optional[Callable[[dict], dict]] = None,
+    loss_impl: str = "reference",
 ) -> Callable:
     """Eval step returning mean loss/accuracy over the batch.
+
+    ``loss_impl``: the tpudl.ops.cross_entropy dispatch seam for the
+    per-example loss ("reference" default = the optax composite;
+    "auto"/"fused" = the vocab-streaming Pallas kernel).
 
     A ``"_valid"`` batch column ([B] 0/1 row mask — see ``pad_batch``)
     switches the reductions to masked means over the real rows only, so
@@ -320,9 +341,9 @@ def make_classification_eval_step(
             variables, *(batch[k] for k in input_keys), train=False
         )
         labels = batch[label_key]
-        per_loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels
-        )
+        from tpudl.ops.cross_entropy import softmax_cross_entropy
+
+        per_loss = softmax_cross_entropy(logits, labels, impl=loss_impl)
         correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
         valid = batch.get("_valid")
         if valid is None:
