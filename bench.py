@@ -438,6 +438,18 @@ def _bench_serve():
     return measure_serve(n_requests=16, num_slots=4)
 
 
+def _bench_serve_replicas():
+    """Multi-replica serving tier (benchmarks/serve_load.py): routed
+    2-replica tokens/sec + scaling efficiency on the ragged mix
+    (simulated per-step device latency — see the benchmark docstring)
+    and resident slots per GB of the int8 paged KV cache. Banked by
+    scripts/bench_regress.py from r06 onward (new keys enter the bank
+    as no-baseline on their first round)."""
+    from benchmarks.serve_load import measure_serve_replicas
+
+    return measure_serve_replicas()
+
+
 def _bench_ft():
     """Fault-tolerance costs (benchmarks/ft_recovery.py): the async
     checkpoint's on-step stall and the kill-to-first-post-restart-step
@@ -535,6 +547,15 @@ def main(argv=None):
         traceback.print_exc()
         serve = {}
     try:
+        serve_replicas = _bench_serve_replicas()
+    except Exception:
+        import sys
+        import traceback
+
+        print("serve replica bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        serve_replicas = {}
+    try:
         ft = _bench_ft()
     except Exception:
         import sys
@@ -628,6 +649,19 @@ def main(argv=None):
         "serve_p99_ttft_ms": serve.get("serve_p99_ttft_ms"),
         "serve_vs_static_batching": serve.get(
             "serve_vs_static_batching"
+        ),
+        # Multi-replica router tier (tpudl.serve.router): routed
+        # 2-replica throughput, scaling efficiency vs 2x one
+        # replica, and the int8 paged KV cache's resident slots
+        # per GB (the capacity lever paging + quantization buy).
+        "serve_tokens_per_sec_2rep": serve_replicas.get(
+            "serve_tokens_per_sec_2rep"
+        ),
+        "serve_scaling_efficiency": serve_replicas.get(
+            "serve_scaling_efficiency"
+        ),
+        "serve_kv_slots_per_gb": serve_replicas.get(
+            "serve_kv_slots_per_gb"
         ),
         # Fault tolerance (tpudl.ft via benchmarks/
         # ft_recovery.py): the async checkpoint's mean on-step

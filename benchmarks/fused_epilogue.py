@@ -89,6 +89,93 @@ def bench_case(name, make_fn, arg_arrays, bytes_fused, bytes_ref):
     return rows
 
 
+def sweep_blocks(args, measure: int = 8):
+    """Grid-search the kernel block-size knobs per family and print the
+    best (the ROADMAP item-1 "tune block sizes" follow-up): row-block
+    heights for the norm + MLP families (they share norms._grid_setup)
+    and the vocab-block cap for cross-entropy. Winners are pinned for a
+    run via TPUDL_NORM_BLOCK_ROWS / TPUDL_CE_VOCAB_BLOCK. Fused forward
+    only — the block choice drives both directions the same way, and
+    the sweep should stay cheap enough to re-run per generation."""
+    from tpudl.ops import cross_entropy as ce_mod
+    from tpudl.ops import norms as norms_mod
+    from tpudl.ops.cross_entropy import softmax_cross_entropy
+    from tpudl.ops.mlp_fused import bias_gelu, swiglu
+    from tpudl.ops.norms import layer_norm, rms_norm
+
+    n = args.rows if args.rows is not None else (128 if args.smoke else
+                                                 256 * 128)
+    h = 128 if args.smoke else args.hidden
+    f = 256 if args.smoke else args.intermediate
+    ce_n = 32 if args.smoke else (args.ce_rows or 4096)
+    v = 512 if args.smoke else args.vocab
+    dtype = jnp.dtype(args.dtype)
+
+    x = jax.random.normal(jax.random.key(0), (n, h), dtype)
+    r = jax.random.normal(jax.random.key(1), (n, h), dtype)
+    scale, bias = jnp.ones((h,)), jnp.zeros((h,))
+    xf = jax.random.normal(jax.random.key(2), (n, f), dtype)
+    uf = jax.random.normal(jax.random.key(3), (n, f), dtype)
+    bf = jnp.zeros((f,))
+    logits = jax.random.normal(jax.random.key(4), (ce_n, v),
+                               jnp.float32) * 3
+    labels = jax.random.randint(jax.random.key(5), (ce_n,), 0, v)
+
+    row_grid = [16, 32] if args.smoke else [16, 32, 64, 128, 256, 512]
+    vocab_grid = [128, 256] if args.smoke else [128, 256, 512, 1024, 2048]
+    families = [
+        ("layer_norm+residual", norms_mod, "BLOCK_ROWS_OVERRIDE",
+         row_grid, "TPUDL_NORM_BLOCK_ROWS",
+         lambda: layer_norm(x, scale, bias, r, return_sum=False,
+                            impl="fused")),
+        ("rms_norm+residual", norms_mod, "BLOCK_ROWS_OVERRIDE",
+         row_grid, "TPUDL_NORM_BLOCK_ROWS",
+         lambda: rms_norm(x, scale, r, impl="fused")[0]),
+        ("bias_gelu", norms_mod, "BLOCK_ROWS_OVERRIDE",
+         row_grid, "TPUDL_NORM_BLOCK_ROWS",
+         lambda: bias_gelu(xf, bf, impl="fused")),
+        ("swiglu", norms_mod, "BLOCK_ROWS_OVERRIDE",
+         row_grid, "TPUDL_NORM_BLOCK_ROWS",
+         lambda: swiglu(uf, xf, impl="fused")),
+        ("cross_entropy", ce_mod, "VOCAB_BLOCK_OVERRIDE",
+         vocab_grid, "TPUDL_CE_VOCAB_BLOCK",
+         lambda: softmax_cross_entropy(logits, labels, impl="fused")),
+    ]
+    print(f"block-size sweep: rows={n} hidden={h} intermediate={f} "
+          f"ce=[{ce_n}, {v}] dtype={args.dtype} (fused fwd, "
+          f"measure {measure})")
+    best = {}
+    for name, mod, attr, grid, env, fn in families:
+        results = []
+        for block in grid:
+            setattr(mod, attr, block)
+            try:
+                jit_fn = jax.jit(fn)
+
+                def run():
+                    jax.tree.leaves(jit_fn())[0].block_until_ready()
+
+                run()  # compile at THIS block size
+                t0 = time.perf_counter()
+                for _ in range(measure):
+                    run()
+                dt = (time.perf_counter() - t0) / measure
+                results.append((block, dt))
+                print(f"{name:>24} block {block:>5} {dt * 1e3:>9.3f} ms",
+                      flush=True)
+            except Exception as e:  # pragma: no cover
+                print(f"{name:>24} block {block:>5} FAILED "
+                      f"{type(e).__name__}: {str(e)[:80]}", flush=True)
+            finally:
+                setattr(mod, attr, None)
+        if results:
+            block, dt = min(results, key=lambda bt: bt[1])
+            best[name] = block
+            print(f"{name:>24} BEST  {block:>5} {dt * 1e3:>9.3f} ms  "
+                  f"(pin with {env}={block})", flush=True)
+    return best
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rows", type=int, default=None,
@@ -103,7 +190,15 @@ def main(argv=None):
                     choices=["bfloat16", "float32"])
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for off-TPU plumbing checks")
+    ap.add_argument("--sweep-blocks", action="store_true",
+                    help="grid-search kernel block sizes per family and "
+                    "print the best (pin via TPUDL_NORM_BLOCK_ROWS / "
+                    "TPUDL_CE_VOCAB_BLOCK)")
     args = ap.parse_args(argv)
+
+    if args.sweep_blocks:
+        sweep_blocks(args)
+        return
 
     from tpudl.ops.cross_entropy import (
         softmax_cross_entropy,

@@ -20,11 +20,29 @@ batches, the reference-style baseline). Two speedups are reported:
 ``speedup_steps`` (decode-step count, deterministic — the number the
 tier-1 test asserts, immune to host jitter).
 
+Multi-replica scaling (``--replicas 1 2 4``): the same ragged workload
+through a tpudl.serve.Router over N engine replicas. Each replica
+thread's compiled calls carry a SIMULATED per-step device latency
+(``--sim-step-ms``, sleeps release the GIL so replica threads overlap
+exactly like N real accelerator meshes would) — on one CPU the real
+matmuls serialize across threads, so the sim keeps the curve about
+what this benchmark measures: router placement + engine orchestration
+overhead, the thing that must NOT serialize. The sweep asserts >= 1.7x
+tokens/sec at 2 replicas, and ``kv_capacity_report`` asserts the int8
+paged cache holds >= 1.8x resident slots per byte vs the dense f32
+layout. ``run_router_overload`` drives open-loop overload against a
+TTFT SloMonitor per replica: sheds must come from SLO burn (not queue
+overflow) with admitted p99 TTFT inside the objective.
+
     python -m benchmarks.serve_load                # one JSON blob
     python -m benchmarks.serve_load --rates 5 20 80  # + open-loop sweep
+    python -m benchmarks.serve_load --replicas 1 2 4 # + scaling curve
+    python -m benchmarks.serve_load --overload       # + SLO shed run
 
 bench.py records ``serve_tokens_per_sec`` / ``serve_p99_ttft_ms`` /
-``serve_vs_static_batching`` from ``measure_serve()`` each round.
+``serve_vs_static_batching`` from ``measure_serve()`` and
+``serve_tokens_per_sec_2rep`` / ``serve_scaling_efficiency`` /
+``serve_kv_slots_per_gb`` from ``measure_serve_replicas()`` each round.
 """
 
 from __future__ import annotations
@@ -68,14 +86,110 @@ def build_session(
     return session, model, params
 
 
+def _with_sim_latency(call, sim_step_s: float):
+    """Wrap a compiled call with an added post-dispatch sleep modeling
+    per-step device latency. The sleep releases the GIL, so N replica
+    threads overlap the way N real accelerator meshes would — the
+    benchmark then measures whether the HOST side (router placement +
+    engine bookkeeping) keeps up, which is the scaling question."""
+    if not sim_step_s:
+        return call
+    import jax
+
+    def wrapped(*args):
+        out = call(*args)
+        jax.block_until_ready(out)
+        time.sleep(sim_step_s)
+        return out
+
+    return wrapped
+
+
+def build_programs(
+    num_slots: int = 4,
+    max_seq_len: int = MAX_SEQ_LEN,
+    paged: bool = False,
+    page_size: int = 16,
+    kv_dtype=None,
+):
+    """Compile the serving programs ONCE and share them across every
+    replica (jitted callables are pure and thread-safe; each replica
+    still owns its private cache/queue/engine) — N replicas cost one
+    compilation, here and on a real pod with identical meshes."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudl.models.generate import (
+        decode_fn,
+        paged_decode_fn,
+        prefill_fn,
+    )
+    from tpudl.models.llama import LLAMA_TINY, LlamaForCausalLM
+
+    cfg = LLAMA_TINY(dtype=jnp.float32, max_seq_len=max_seq_len)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, PROMPT_LEN), jnp.int32)
+    )["params"]
+    pf = prefill_fn(model)
+    ids = jax.ShapeDtypeStruct((num_slots, PROMPT_LEN), jnp.int32)
+    _, template = jax.eval_shape(pf, params, ids, ids)
+    if paged:
+        decode = jax.jit(
+            paged_decode_fn(model, page_size, kv_dtype == "int8")
+        )
+    else:
+        decode = jax.jit(decode_fn(model))
+    return {
+        "model": model, "params": params, "prefill": jax.jit(pf),
+        "decode": decode, "template": template, "paged": paged,
+        "page_size": page_size, "kv_dtype": kv_dtype,
+        "num_slots": num_slots,
+    }
+
+
+def session_from_programs(
+    programs: dict,
+    sim_step_s: float = 0.0,
+    clock=time.perf_counter,
+    **kwargs,
+):
+    """One replica's ServeSession over the shared compiled programs."""
+    from tpudl.serve import ServeSession
+    from tpudl.serve.cache import PagedKVCache
+
+    cache = None
+    if programs["paged"]:
+        cache = PagedKVCache(
+            programs["template"],
+            page_size=programs["page_size"],
+            kv_dtype=programs["kv_dtype"],
+        )
+    session = ServeSession(
+        programs["prefill"], programs["decode"], programs["params"],
+        programs["template"], PROMPT_LEN, cache=cache, clock=clock,
+        **kwargs,
+    )
+    session.engine.prefill_call = _with_sim_latency(
+        session.engine.prefill_call, sim_step_s
+    )
+    session.engine.decode_call = _with_sim_latency(
+        session.engine.decode_call, sim_step_s
+    )
+    return session
+
+
 def make_requests(
     n: int,
     seed: int = 0,
     long_every: int = 4,
     deadline_s: Optional[float] = None,
     vocab_size: int = 512,
+    best_effort_every: Optional[int] = None,
 ) -> List:
-    """Ragged request mix: every ``long_every``-th request is long."""
+    """Ragged request mix: every ``long_every``-th request is long;
+    every ``best_effort_every``-th (when set) is priority-1 — the
+    class the router sheds first under SLO burn."""
     from tpudl.serve import Request
 
     rng = np.random.default_rng(seed)
@@ -92,6 +206,11 @@ def make_requests(
                     LONG_TOKENS if i % long_every == 0 else SHORT_TOKENS
                 ),
                 deadline_s=deadline_s,
+                priority=(
+                    1
+                    if best_effort_every and i % best_effort_every == 0
+                    else 0
+                ),
             )
         )
     return out
@@ -220,6 +339,259 @@ def compare_continuous_vs_static(
     }
 
 
+# ---------------------------------------------------------------------------
+# Multi-replica router benchmarks
+# ---------------------------------------------------------------------------
+
+
+def run_replica_sweep(
+    replica_counts=(1, 2, 4),
+    n_requests: int = 64,
+    num_slots: int = 4,
+    sim_step_ms: float = 30.0,
+    paged: bool = True,
+    kv_dtype=None,
+    seed: int = 0,
+    assert_scaling: Optional[float] = 1.7,
+) -> dict:
+    """Tokens/sec scaling curve over router replica counts: the SAME
+    ragged workload (fixed total tokens) served by 1/2/4 replica
+    engines behind one Router. ``assert_scaling`` (None disables)
+    checks the 2-replica point — the acceptance bar for "the router
+    does not serialize what the replicas parallelize"."""
+    from tpudl.serve import Replica, Router
+
+    programs = build_programs(
+        num_slots, paged=paged, kv_dtype=kv_dtype
+    )
+    # Compile + warm every program shape OUTSIDE the timed windows.
+    warm = session_from_programs(programs)
+    warmup_session(warm)
+    sweep = []
+    for count in replica_counts:
+        replicas = [
+            Replica(
+                f"r{i}",
+                session_from_programs(
+                    programs, sim_step_s=1e-3 * sim_step_ms
+                ),
+            )
+            for i in range(count)
+        ]
+        requests = make_requests(n_requests, seed)
+        with Router(replicas) as router:
+            t0 = time.perf_counter()
+            results = router.serve(requests, timeout_s=600.0)
+            elapsed = time.perf_counter() - t0
+        stats = _latency_stats(results)
+        stats.update(
+            replicas=count,
+            wall_s=round(elapsed, 4),
+            tokens_per_sec=round(stats["tokens"] / elapsed, 2),
+        )
+        sweep.append(stats)
+    per_replica_base = sweep[0]["tokens_per_sec"] / sweep[0]["replicas"]
+    for stats in sweep:
+        stats["scaling_x"] = round(
+            stats["tokens_per_sec"] / per_replica_base, 3
+        )
+        stats["scaling_efficiency"] = round(
+            stats["scaling_x"] / stats["replicas"], 3
+        )
+    out = {
+        "sim_step_ms": sim_step_ms,
+        "num_slots": num_slots,
+        "n_requests": n_requests,
+        "paged": paged,
+        "kv_dtype": kv_dtype,
+        "sweep": sweep,
+    }
+    if assert_scaling is not None:
+        two = next(
+            (s for s in sweep if s["replicas"] == 2), None
+        )
+        if two is not None:
+            assert two["scaling_x"] >= assert_scaling, (
+                f"2-replica scaling {two['scaling_x']}x is below the "
+                f"{assert_scaling}x bar — the router is serializing "
+                f"replica work (sweep: "
+                f"{[(s['replicas'], s['scaling_x']) for s in sweep]})"
+            )
+    return out
+
+
+def run_router_overload(
+    num_replicas: int = 2,
+    offered_rate: float = 300.0,
+    n_requests: int = 150,
+    ttft_objective_ms: float = 300.0,
+    sim_step_ms: float = 4.0,
+    num_slots: int = 4,
+    seed: int = 0,
+    check: bool = True,
+    shed_margin: float = 0.6,
+) -> dict:
+    """Open-loop OVERLOAD against SLO-aware admission: each replica
+    carries a TTFT SloMonitor; arrivals far beyond capacity must shed
+    via SLO burn (``shed_slo``) — not queue overflow — so the p99 TTFT
+    of the requests actually admitted stays inside the objective.
+    ``check=True`` asserts exactly that (the acceptance criterion).
+
+    The monitors alert on ``shed_margin x`` the external objective (the
+    SRE tighter-internal-bar idiom): burn detection needs violations to
+    fire, so alerting AT the objective would only engage after the
+    tail already blew it — the margin absorbs the detector lag."""
+    from tpudl.obs.slo import Objective, SloMonitor
+    from tpudl.serve import Replica, Router
+
+    programs = build_programs(num_slots, paged=True)
+    warm = session_from_programs(programs)
+    warmup_session(warm)
+    replicas = []
+    for i in range(num_replicas):
+        monitor = SloMonitor([
+            Objective(
+                name=f"ttft_r{i}",
+                metric="serve_ttft_ms",
+                threshold=shed_margin * ttft_objective_ms,
+                quantile=0.95,
+                window_s=4.0,
+                fast_window_s=0.5,
+                min_count=3,
+            )
+        ])
+        replicas.append(
+            Replica(
+                f"r{i}",
+                session_from_programs(
+                    programs,
+                    sim_step_s=1e-3 * sim_step_ms,
+                    slo=monitor,
+                    # Deep queues: capacity sheds must NOT be the relief
+                    # valve — the SLO burn is.
+                    queue_capacity=4 * n_requests,
+                ),
+            )
+        )
+    # 30% best-effort traffic: the class the ROUTER sheds at the door
+    # while any replica burns.
+    requests = make_requests(
+        n_requests, seed, deadline_s=None, best_effort_every=3
+    )
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(
+        rng.exponential(1.0 / offered_rate, size=len(requests))
+    )
+    with Router(replicas) as router:
+        t0 = time.perf_counter()
+        for request, due in zip(requests, arrivals):
+            lag = due - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            router.submit(request)
+        results = router.collect(timeout_s=600.0)
+        elapsed = time.perf_counter() - t0
+    stats = _latency_stats(results)
+    reasons: Dict[str, int] = {}
+    for r in results.values():
+        reasons[r.finish_reason] = reasons.get(r.finish_reason, 0) + 1
+    stats.update(
+        mode="router_overload",
+        replicas=num_replicas,
+        offered_rate=offered_rate,
+        ttft_objective_ms=ttft_objective_ms,
+        wall_s=round(elapsed, 4),
+        tokens_per_sec=round(stats["tokens"] / elapsed, 2),
+        finish_reasons=reasons,
+    )
+    if check:
+        assert reasons.get("shed_slo", 0) > 0, (
+            f"overload produced no SLO sheds (reasons: {reasons}) — "
+            f"the burn-rate admission path never engaged"
+        )
+        assert reasons.get("shed_capacity", 0) == 0, (
+            f"overload shed by queue overflow, not SLO burn "
+            f"(reasons: {reasons})"
+        )
+        p99 = stats["ttft"]["p99_ms"]
+        assert p99 is not None and p99 <= ttft_objective_ms, (
+            f"admitted p99 TTFT {p99} ms blew the {ttft_objective_ms} "
+            f"ms objective despite SLO shedding"
+        )
+    return stats
+
+
+def kv_capacity_report(
+    num_slots: int = 8,
+    max_seq_len: int = MAX_SEQ_LEN,
+    page_size: int = 16,
+    check: bool = True,
+) -> dict:
+    """Resident-slots-per-byte: the dense f32 fixed-slot cache vs the
+    paged cache (f32 and int8 pools) at identical logical capacity.
+    The int8 pool must hold >= 1.8x the slots per byte (it measures
+    ~3.5x: 4x from the dtype minus per-row scales and page-table
+    overhead) — the KV-residency lever behind the whole paging tier."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpudl.models.generate import prefill_fn
+    from tpudl.models.llama import LLAMA_TINY, LlamaForCausalLM
+    from tpudl.serve.cache import PagedKVCache, SlotCache
+
+    cfg = LLAMA_TINY(dtype=jnp.float32, max_seq_len=max_seq_len)
+    model = LlamaForCausalLM(cfg)
+    params = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((1, PROMPT_LEN), jnp.int32)
+        )["params"]
+    )
+    ids = jax.ShapeDtypeStruct((num_slots, PROMPT_LEN), jnp.int32)
+    _, template = jax.eval_shape(
+        prefill_fn(model), params, ids, ids
+    )
+    dense = SlotCache(template)
+    paged_f32 = PagedKVCache(template, page_size=page_size)
+    paged_int8 = PagedKVCache(template, page_size=page_size, kv_dtype="int8")
+    out = {
+        "num_slots": num_slots,
+        "max_seq_len": max_seq_len,
+        "page_size": page_size,
+        "dense_f32_bytes": dense.nbytes,
+        "paged_f32_bytes": paged_f32.nbytes,
+        "paged_int8_bytes": paged_int8.nbytes,
+        # Same resident slots each, so slots-per-byte ratios are just
+        # byte ratios.
+        "int8_slots_per_byte_x": round(dense.nbytes / paged_int8.nbytes, 3),
+        "serve_kv_slots_per_gb": round(
+            num_slots / (paged_int8.nbytes / 2**30), 1
+        ),
+    }
+    if check:
+        assert out["int8_slots_per_byte_x"] >= 1.8, (
+            f"int8 paged cache holds only "
+            f"{out['int8_slots_per_byte_x']}x the slots per byte of the "
+            f"dense cache (bar: 1.8x) — quantized storage is not paying"
+        )
+    return out
+
+
+def measure_serve_replicas() -> dict:
+    """The bench.py entry for the multi-replica tier: 2-replica
+    throughput + scaling efficiency (routed tokens/sec vs 2x the
+    1-replica engine) and the int8 paged KV capacity metric."""
+    cap = kv_capacity_report()
+    sweep = run_replica_sweep(replica_counts=(1, 2))
+    one, two = sweep["sweep"][0], sweep["sweep"][1]
+    return {
+        "serve_tokens_per_sec_2rep": two["tokens_per_sec"],
+        "serve_scaling_efficiency": round(
+            two["tokens_per_sec"] / (2.0 * one["tokens_per_sec"]), 3
+        ),
+        "serve_kv_slots_per_gb": cap["serve_kv_slots_per_gb"],
+    }
+
+
 def measure_serve(n_requests: int = 16, num_slots: int = 4) -> dict:
     """The bench.py entry: headline serving numbers for one round."""
     cmp = compare_continuous_vs_static(n_requests, num_slots)
@@ -252,6 +624,26 @@ def main(argv=None) -> int:
         help="per-request deadline for the open-loop sweep (sheds under "
         "overload)",
     )
+    ap.add_argument(
+        "--replicas", type=int, nargs="*", default=[],
+        help="router replica counts to sweep (e.g. 1 2 4): tokens/sec "
+        "scaling curve, asserts >=1.7x at 2 replicas and the int8 "
+        "paged-KV capacity bar",
+    )
+    ap.add_argument(
+        "--sim-step-ms", type=float, default=30.0,
+        help="simulated per-step device latency for the replica sweep "
+        "(models the accelerator the CPU container does not have)",
+    )
+    ap.add_argument(
+        "--kv", choices=["f32", "int8"], default="f32",
+        help="paged KV storage for the replica sweep",
+    )
+    ap.add_argument(
+        "--overload", action="store_true",
+        help="run the open-loop router overload: SLO-burn shedding "
+        "with admitted p99 TTFT inside the objective (asserted)",
+    )
     args = ap.parse_args(argv)
 
     out = compare_continuous_vs_static(args.requests, args.slots, args.seed)
@@ -270,6 +662,15 @@ def main(argv=None) -> int:
         )
     if sweeps:
         out["open_loop_sweep"] = sweeps
+    if args.replicas:
+        out["kv_capacity"] = kv_capacity_report()
+        out["replica_sweep"] = run_replica_sweep(
+            replica_counts=tuple(args.replicas),
+            sim_step_ms=args.sim_step_ms,
+            kv_dtype=None if args.kv == "f32" else args.kv,
+        )
+    if args.overload:
+        out["router_overload"] = run_router_overload()
     print(json.dumps(out, indent=2))
     return 0
 

@@ -65,6 +65,10 @@ NOISE_BAND_FLOORS = {
     "resnet18_images_per_sec_chip": 0.25,
     "serve_tokens_per_sec": 0.20,
     "serve_p99_ttft_ms": 0.50,
+    # Router sweep rides threads on 1 vCPU in the container: scheduler
+    # jitter moves the routed throughput more than the engine's.
+    "serve_tokens_per_sec_2rep": 0.25,
+    "serve_scaling_efficiency": 0.15,
     "input_pipeline_images_per_sec_host": 0.20,
     "checkpoint_step_stall_ms": 0.50,
     "checkpoint_sync_save_ms": 0.50,

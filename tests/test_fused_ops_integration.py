@@ -307,3 +307,58 @@ def test_bert_param_tree_identical_across_modes():
             )
         }
     assert trees[False] == trees["force"]
+
+
+def test_block_size_overrides_preserve_parity():
+    """The --sweep-blocks knobs (norms.BLOCK_ROWS_OVERRIDE /
+    cross_entropy.VOCAB_BLOCK_OVERRIDE) change only the kernel grid:
+    fused outputs at a non-default block size still match the
+    composite references (interpret mode on CPU)."""
+    from tpudl.ops import cross_entropy as ce_mod
+    from tpudl.ops import norms as norms_mod
+    from tpudl.ops.cross_entropy import (
+        softmax_cross_entropy,
+        softmax_cross_entropy_ref,
+    )
+    from tpudl.ops.norms import layer_norm, layer_norm_ref
+
+    x = jax.random.normal(jax.random.key(0), (48, 96), jnp.float32)
+    scale = jnp.ones((96,))
+    bias = jnp.full((96,), 0.1)
+    logits = jax.random.normal(jax.random.key(1), (24, 384), jnp.float32)
+    labels = jax.random.randint(jax.random.key(2), (24,), 0, 384)
+    try:
+        norms_mod.BLOCK_ROWS_OVERRIDE = 32
+        ce_mod.VOCAB_BLOCK_OVERRIDE = 128
+        np.testing.assert_allclose(
+            layer_norm(x, scale, bias, impl="fused"),
+            layer_norm_ref(x, scale, bias),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            softmax_cross_entropy(logits, labels, impl="fused"),
+            softmax_cross_entropy_ref(logits, labels),
+            rtol=1e-5, atol=1e-5,
+        )
+    finally:
+        norms_mod.BLOCK_ROWS_OVERRIDE = None
+        ce_mod.VOCAB_BLOCK_OVERRIDE = None
+    try:
+        norms_mod.BLOCK_ROWS_OVERRIDE = 0
+        with pytest.raises(ValueError, match="block-rows"):
+            layer_norm(x, scale, bias, impl="fused")
+    finally:
+        norms_mod.BLOCK_ROWS_OVERRIDE = None
+
+
+def test_fused_epilogue_sweep_blocks_smoke():
+    """benchmarks/fused_epilogue.py --sweep-blocks finds a best block
+    per family at smoke shapes (CPU interpret mode) and restores the
+    heuristic (override None) afterwards."""
+    from benchmarks.fused_epilogue import main as bench_main
+    from tpudl.ops import cross_entropy as ce_mod
+    from tpudl.ops import norms as norms_mod
+
+    bench_main(["--sweep-blocks", "--smoke"])
+    assert norms_mod.BLOCK_ROWS_OVERRIDE is None
+    assert ce_mod.VOCAB_BLOCK_OVERRIDE is None

@@ -21,6 +21,7 @@ from tpudl.models.generate import generate
 from tpudl.models.llama import LLAMA_TINY, LlamaForCausalLM
 from tpudl.serve import (
     AdmissionQueue,
+    PagedKVCache,
     Request,
     ServeSession,
     SlotCache,
@@ -427,6 +428,467 @@ def test_slot_cache_bookkeeping():
         SlotCache({"k": jax.ShapeDtypeStruct((3, 16), jnp.float32)})
 
 
+def test_admission_queue_starvation_promotion():
+    """The aged-FIFO guard: a low-priority entry that has waited past
+    promote_after_s is served next regardless of the high-priority
+    stream still arriving — bounded wait instead of starving forever."""
+    t = [0.0]
+    q = AdmissionQueue(capacity=8, clock=lambda: t[0], promote_after_s=5.0)
+    assert q.push("low", priority=9)
+    assert q.push("hi0", priority=0)
+    entry, _ = q.pop()
+    assert entry.request == "hi0"  # not aged yet: priority order holds
+    t[0] = 6.0  # "low" has now waited past the promotion bound
+    q.push("hi1", priority=0)
+    entry, _ = q.pop()
+    assert entry.request == "low"  # aged FIFO promotion
+    entry, _ = q.pop()
+    assert entry.request == "hi1"
+    assert len(q) == 0
+
+    # An aged head that fails the fit filter doesn't block normal pops.
+    class R:
+        def __init__(self, name, big=False):
+            self.name, self.big = name, big
+
+    q.push(R("big-old", big=True), priority=9)
+    t[0] += 6.0
+    q.push(R("small"), priority=0)
+    entry, _ = q.pop(fit=lambda r: not r.big)
+    assert entry.request.name == "small"
+
+    # promote_after_s=None disables promotion entirely.
+    t2 = [0.0]
+    q2 = AdmissionQueue(capacity=8, clock=lambda: t2[0],
+                        promote_after_s=None)
+    q2.push("low", priority=9)
+    t2[0] = 1e9
+    q2.push("hi", priority=0)
+    entry, _ = q2.pop()
+    assert entry.request == "hi"
+    with pytest.raises(ValueError, match="promote_after_s"):
+        AdmissionQueue(promote_after_s=0)
+
+
+def test_admission_queue_deadline_heap_and_lazy_deletion():
+    """Expiry comes off the dedicated deadline min-heap (O(expired log
+    n), not a full scan) with lazy deletion: entries consumed through
+    one index never resurface through another."""
+    t = [0.0]
+    q = AdmissionQueue(capacity=16, clock=lambda: t[0])
+    q.push("a", deadline_s=1.0)
+    q.push("b", deadline_s=2.0)
+    q.push("c", deadline_s=3.0)
+    q.push("d")
+    entry, shed = q.pop()
+    assert entry.request == "a" and not shed  # popped before expiry
+    t[0] = 2.5  # a is consumed, b expired: only b sheds
+    entry, shed = q.pop()
+    assert entry.request == "c"
+    assert [e.request for e in shed] == ["b"]
+    assert len(q) == 1  # just d
+    # drain_all hands back scheduling order and empties EVERY index —
+    # no stale entry sheds later from the deadline heap or FIFO.
+    q.push("e", priority=1, deadline_s=9.0)
+    q.push("f", priority=0)
+    assert [e.request for e in q.drain_all()] == ["d", "f", "e"]
+    assert len(q) == 0
+    t[0] = 1e9
+    assert q.drain_expired() == []
+    assert q.pop() == (None, [])
+
+
+# ---------------------------------------------------------------------------
+# Paged + quantized KV cache.
+# ---------------------------------------------------------------------------
+
+
+def _paged_template(num_slots=2, seq=32, hkv=2, hd=4):
+    shape = jax.ShapeDtypeStruct
+    return {
+        "layer": {
+            "k": shape((num_slots, seq, hkv, hd), jnp.float32),
+            "v": shape((num_slots, seq, hkv, hd), jnp.float32),
+            "valid": shape((num_slots, seq), jnp.bool_),
+            "index": shape((), jnp.int32),
+        }
+    }
+
+
+def _paged_row(seq=32, hkv=2, hd=4, fill=1.0):
+    return {
+        "layer": {
+            "k": jnp.full((1, seq, hkv, hd), fill, jnp.float32),
+            "v": jnp.full((1, seq, hkv, hd), -fill, jnp.float32),
+            "valid": jnp.ones((1, seq), jnp.bool_),
+            "index": jnp.int32(8),
+        }
+    }
+
+
+def test_paged_cache_seating_and_reservation():
+    cache = PagedKVCache(_paged_template(), page_size=8)
+    assert (cache.num_slots, cache.max_seq_len) == (2, 32)
+    assert cache.pages_per_slot == 4
+    assert cache.free_pages == 8  # 2 slots x 4 pages; page 0 is trash
+    assert cache.fits_tokens(64) and not cache.fits_tokens(65)
+    cache.seat(_paged_row(), 0, pad=2, prompt_len=8, reserve_tokens=16)
+    assert cache.free_pages == 6  # ceil(16 / 8) = 2 pages reserved
+    assert cache.page_table[0, 0] != 0  # mapped off the trash page
+    assert (cache.start[0], cache.lens[0]) == (2, 8)
+    # The prompt region actually landed in the mapped page.
+    page = int(cache.page_table[0, 0])
+    assert float(
+        jnp.abs(cache.cache["layer"]["pages_k"][page]).sum()
+    ) > 0
+    with pytest.raises(ValueError, match="already seated"):
+        cache.seat(_paged_row(), 0, pad=0, prompt_len=8, reserve_tokens=8)
+    with pytest.raises(ValueError, match="exceeds the logical"):
+        cache.seat(_paged_row(), 1, pad=0, prompt_len=8, reserve_tokens=33)
+    cache.advance([0])
+    assert cache.lens[0] == 9
+    cache.free(0)
+    assert cache.free_pages == 8
+    assert (cache.page_table[0] == 0).all()  # back on the trash page
+    assert cache.lens[0] == 0
+    # Exhaustion raises when admission is bypassed (fits_tokens is the
+    # predicate that makes this unreachable in the engine).
+    small = PagedKVCache(_paged_template(), page_size=8, num_pages=6)
+    small.seat(_paged_row(), 0, pad=0, prompt_len=8, reserve_tokens=32)
+    assert small.free_pages == 1
+    assert not small.fits_tokens(16)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        small.seat(_paged_row(), 1, pad=0, prompt_len=8, reserve_tokens=16)
+    small.reset()
+    assert small.free_pages == 5
+    with pytest.raises(ValueError, match="page_size"):
+        PagedKVCache(_paged_template(), page_size=0)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        PagedKVCache(_paged_template(), kv_dtype="int4")
+    with pytest.raises(ValueError, match="validity"):
+        PagedKVCache({"k": jax.ShapeDtypeStruct((3, 16), jnp.float32)})
+
+
+def test_cache_bytes_accounting_matches_buffers():
+    """The regression the ISSUE names: ``nbytes`` (the serve_cache_bytes
+    gauge's source) must equal the ACTUAL buffer bytes — quantized
+    pools report int8 + scale bytes, not the dense dtype, and the
+    host-side page-table/start/len addressing is counted."""
+    template = _paged_template()
+    dense = SlotCache(template)
+    assert dense.nbytes == sum(
+        leaf.nbytes for leaf in jax.tree.leaves(dense.cache)
+    )
+    f32 = PagedKVCache(template, page_size=8)
+    q8 = PagedKVCache(template, page_size=8, kv_dtype="int8")
+    for paged in (f32, q8):
+        device = sum(
+            leaf.nbytes for leaf in jax.tree.leaves(paged.cache)
+        )
+        host = (
+            paged.page_table.nbytes + paged.start.nbytes
+            + paged.lens.nbytes
+        )
+        assert paged.nbytes == device + host
+    # int8 pools really store int8 values (+f32 scales): the dense-
+    # dtype assumption would report 4x these bytes.
+    assert q8.cache["layer"]["pages_k"].dtype == jnp.int8
+    assert q8.cache["layer"]["scale_k"].dtype == jnp.float32
+    value_bytes = q8.cache["layer"]["pages_k"].nbytes
+    assert value_bytes * 4 == f32.cache["layer"]["pages_k"].nbytes
+    assert q8.nbytes < f32.nbytes
+
+
+def test_paged_rollover_free_long_generation():
+    """The workload that forces the dense cache to roll over (see
+    test_horizon_rollover_preserves_parity: 5 x 20-token requests
+    through 2 slots of a 32-position model — cumulative decode writes
+    cross the shared horizon several times) runs rollover-FREE on the
+    paged cache, with identical tokens: slots recycle piecewise, no
+    shared write index exists."""
+    model = LlamaForCausalLM(LLAMA_TINY(dtype=jnp.float32, max_seq_len=32))
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, PROMPT_LEN), jnp.int32)
+    )["params"]
+    session = ServeSession.from_model(
+        model, params, prompt_len=PROMPT_LEN, num_slots=2, paged=True,
+    )
+    rng = np.random.default_rng(5)
+    requests = [
+        Request(f"r{i}", rng.integers(1, 500, size=5).tolist(),
+                max_new_tokens=20)
+        for i in range(5)
+    ]
+    total_decode_tokens = sum(r.max_new_tokens for r in requests)
+    assert total_decode_tokens > 32  # crosses what was the horizon
+    results = session.serve(requests)
+    assert session.engine.num_rollovers == 0
+    assert session.engine.cache.free_pages == session.engine.cache.num_pages - 1
+    for req in requests:
+        want = np.asarray(
+            generate(model, params, jnp.asarray(req.input_ids)[None, :],
+                     max_new_tokens=20)
+        )[0]
+        np.testing.assert_array_equal(
+            np.asarray(results[req.request_id].tokens), want
+        )
+
+
+def test_int8_kv_decode_parity_at_tolerance(model_and_params):
+    """int8 paged KV vs the f32 path: greedy decode matches generate()
+    except at genuine near-ties (reference top-2 logit margin within
+    atol — the quantization contract assert_serving_parity's tolerance
+    mode checks); the cache_bytes gauge reports the QUANTIZED bytes."""
+    from tpudl.obs import registry
+
+    model, params = model_and_params
+    session = ServeSession.from_model(
+        model, params, prompt_len=PROMPT_LEN, num_slots=SLOTS,
+        paged=True, kv_dtype="int8",
+    )
+    assert session.engine.cache.quantized
+    assert (
+        registry().gauge("serve_cache_bytes").value
+        == session.engine.cache.nbytes
+    )
+    assert_serving_parity(
+        session, model, params, _ragged_requests(8, seed=1), atol=0.05
+    )
+    assert session.engine.num_rollovers == 0
+
+
+def test_streaming_matches_collect(model_and_params):
+    """session.stream() delivers every request's tokens incrementally;
+    the concatenated chunks AND the final Result are byte-identical to
+    a submit/collect run of the same requests (streaming changes
+    delivery, not generation)."""
+    model, params = model_and_params
+    requests = _ragged_requests(6, seed=11)
+    ref = _session(model, params).serve(
+        [Request(**r.__dict__) for r in requests]
+    )
+    session = _session(model, params)
+    chunks, finals, order = {}, {}, {}
+    for chunk in session.stream([Request(**r.__dict__) for r in requests]):
+        chunks.setdefault(chunk.request_id, []).extend(chunk.tokens)
+        order.setdefault(chunk.request_id, 0)
+        order[chunk.request_id] += 1
+        if chunk.done:
+            finals[chunk.request_id] = chunk.result
+    assert set(finals) == set(ref)
+    for rid in ref:
+        assert chunks[rid] == finals[rid].tokens == ref[rid].tokens, rid
+        assert finals[rid].finish_reason == ref[rid].finish_reason
+        # Tokens arrived incrementally, not one collect-at-eos blob.
+        assert order[rid] >= 2 or len(ref[rid].tokens) <= 1
+    assert session.engine.on_token is None  # feed uninstalled
+    with pytest.raises(ValueError, match="chunk_tokens"):
+        next(session.stream([], chunk_tokens=0))
+
+
+def test_stream_validates_and_submits_at_call_time(model_and_params):
+    """stream() does its validation, its submission, and its claim on
+    the engine's token feed AT CALL TIME: misuse raises at the call
+    site (not at a far-away first iteration), a second concurrent
+    stream is rejected up front, and requests handed to a stream the
+    caller never iterates are still admitted — collect() finishes
+    them."""
+    model, params = model_and_params
+    session = _session(model, params)
+    with pytest.raises(ValueError, match="chunk_tokens"):
+        session.stream([], chunk_tokens=0)  # no next() needed
+    req = _ragged_requests(1, seed=13)[0]
+    gen = session.stream([req])  # never iterated
+    assert session.engine.on_token is not None  # feed claimed eagerly
+    with pytest.raises(RuntimeError, match="already active"):
+        session.stream([])
+    results = session.collect()  # the un-iterated stream's request ran
+    assert results[req.request_id].finish_reason == "length"
+    assert len(results[req.request_id].tokens) == req.max_new_tokens
+    with pytest.raises(StopIteration):
+        next(gen)  # nothing pending: exhausts and releases the feed
+    assert session.engine.on_token is None
+    # A failing submit releases the feed too (no stuck claim).
+    with pytest.raises(ValueError, match="duplicate"):
+        session.stream([Request(**req.__dict__)] * 2)
+    assert session.engine.on_token is None
+
+
+def test_stream_abandoned_and_stale_feed_reclaim(model_and_params):
+    """Two feed-ownership regressions: a stream() whose generator was
+    dropped (GC'd) before its first iteration must not wedge the
+    session — the next stream() reclaims the token feed and delivers
+    the abandoned stream's admitted work too — and a STARTED generator
+    that lost the feed (collect() released it, a new stream claimed it)
+    stops silently instead of stepping the engine under the new
+    owner."""
+    import gc
+
+    model, params = model_and_params
+    session = _session(model, params)
+    session.stream([Request("first", [3, 5, 7], max_new_tokens=4)])
+    gc.collect()  # the un-iterated generator is gone; feed still claimed
+    finals = {}
+    for chunk in session.stream([Request("second", [4, 6], max_new_tokens=3)]):
+        if chunk.done:
+            finals[chunk.request_id] = chunk.result
+    assert set(finals) == {"first", "second"}  # reclaimed, not "active"
+    assert len(finals["first"].tokens) == 4
+
+    gen3 = session.stream([Request("third", [2, 4], max_new_tokens=6)])
+    assert not next(gen3).done  # started and suspended mid-feed
+    session.collect()  # finishes "third", releases gen3's feed
+    gen4 = session.stream([Request("fourth", [9, 1], max_new_tokens=2)])
+    assert list(gen3) == []  # stale: yields nothing, steps nothing
+    assert session.engine.on_token is not None  # gen4 kept its claim
+    finals4 = [c.result for c in gen4 if c.done]
+    assert [r.request_id for r in finals4] == ["fourth"]
+    assert len(finals4[0].tokens) == 2
+
+    # close()d before first iteration: the generator finishes without
+    # ever entering its try, so its finally never releases the feed —
+    # the next stream() must reclaim it (the alive-but-closed branch,
+    # distinct from the GC'd one above).
+    gen5 = session.stream([Request("fifth", [1, 2], max_new_tokens=2)])
+    gen5.close()
+    finals5 = [c.result for c in session.stream([]) if c.done]
+    assert [r.request_id for r in finals5] == ["fifth"]
+
+
+def test_paged_page_size_not_dividing_model_bound(model_and_params):
+    """A page_size that does not divide the model's compiled bound:
+    the logical per-slot bound clamps to model_seq_len (admission must
+    not promise positions the decode program cannot address), and a
+    prompt span that rounds past the dense prefill row zero-pads its
+    last page instead of raising at trace time — which previously
+    struck AFTER pages were reserved, stranding the slot."""
+    model, params = model_and_params
+    session = _session(model, params, paged=True, page_size=100)
+    engine = session.engine
+    assert engine.cache.max_seq_len == CFG.max_seq_len  # clamped, not 100
+    assert engine.max_seq_len == CFG.max_seq_len
+    reqs = _ragged_requests(3, seed=17)
+    results = session.serve(reqs)
+    for req in reqs:
+        want = np.asarray(
+            generate(model, params, jnp.asarray(req.input_ids)[None, :],
+                     max_new_tokens=req.max_new_tokens)
+        )[0]
+        got = np.asarray(results[req.request_id].tokens)
+        np.testing.assert_array_equal(
+            got, want[: got.shape[0]],
+            err_msg=f"{req.request_id} diverged on the padded-page cache",
+        )
+
+
+def test_never_fitting_prefill_inbox_head_sheds(model_and_params):
+    """A prefilled item whose worst case exceeds what even an EMPTY
+    cache could seat must shed (``shed_capacity``) instead of
+    permanently blocking every prefilled request behind it — the
+    disaggregation inbox is a plain deque with no deadline or
+    fit-filtered-pop path, unlike AdmissionQueue."""
+    import time
+
+    from tpudl.serve.engine import _Prefilled, first_token
+    from tpudl.serve.queue import _Entry
+
+    model, params = model_and_params
+    session = _session(model, params)
+    engine = session.engine
+
+    def prefilled(req):
+        ids = np.asarray(req.input_ids, np.int32)
+        pad = PROMPT_LEN - ids.shape[0]
+        padded = np.concatenate([np.zeros(pad, np.int32), ids])[None, :]
+        mask = np.concatenate(
+            [np.zeros(pad, np.int32), np.ones(ids.shape[0], np.int32)]
+        )[None, :]
+        logits, row_cache = engine.prefill_call(engine.params, padded, mask)
+        t = time.monotonic()
+        return _Prefilled(
+            _Entry(priority=0, seq=0, request=req, deadline=None,
+                   submitted_at=t),
+            row_cache, first_token(logits, req), int(ids.shape[0]), t, t,
+        )
+
+    huge = Request("huge", [1, 2, 3], max_new_tokens=CFG.max_seq_len)
+    assert PROMPT_LEN + huge.max_new_tokens > CFG.max_seq_len
+    ok = Request("ok", [4, 5], max_new_tokens=3)
+    engine.prefill_inbox.append(prefilled(huge))
+    engine.prefill_inbox.append(prefilled(ok))
+    engine.run_until_drained()
+    assert engine.results["huge"].finish_reason == "shed_capacity"
+    assert engine.results["huge"].tokens == []
+    assert engine.results["ok"].finish_reason == "length"
+    assert len(engine.results["ok"].tokens) == 3
+    assert not engine.prefill_inbox
+
+
+def test_parity_tolerance_fires_on_wide_margin(model_and_params):
+    """assert_serving_parity's atol (quantized-contract) mode measures
+    the teacher-forced logit margin between the reference's choice and
+    the token the engine ACTUALLY produced: a wide-margin divergence is
+    a cache bug and must fire, tolerance or no tolerance."""
+    import dataclasses
+
+    model, params = model_and_params
+    req = Request("t", [3, 5, 7, 11], max_new_tokens=4)
+    real = _session(model, params).serve([Request(**req.__dict__)])
+    logits = model.apply(
+        {"params": params}, jnp.asarray(req.input_ids, jnp.int32)[None, :]
+    )
+    wrong = int(np.argmin(np.asarray(logits[0, -1])))
+    assert wrong != real["t"].tokens[0]
+    tampered = {
+        "t": dataclasses.replace(
+            real["t"], tokens=[wrong] + list(real["t"].tokens[1:])
+        )
+    }
+
+    class _TamperedSession:
+        def serve(self, requests):
+            return tampered
+
+    with pytest.raises(AssertionError, match="cache bug"):
+        assert_serving_parity(
+            _TamperedSession(), model, params, [req], atol=0.05
+        )
+
+
+def test_admission_queue_lazy_indexes_stay_bounded():
+    """Lazy deletion must not leak: entries consumed through one index
+    are eventually purged from the others — including the FIFO when
+    promotion is disabled (it used to grow one dead entry per push for
+    the process lifetime) and when a stuck live head blocks the
+    head-cleanup path (compaction handles the dead middle)."""
+    t = [0.0]
+    q = AdmissionQueue(capacity=4, clock=lambda: t[0],
+                       promote_after_s=None)
+    for i in range(500):
+        assert q.push(i, deadline_s=5.0)
+        entry, shed = q.pop()
+        assert entry.request == i and not shed
+    assert len(q) == 0
+    assert len(q._fifo) <= 16
+    assert len(q._heap) <= 16
+    assert len(q._by_deadline) <= 16
+
+    # A live low-priority head parks in the FIFO while 500 higher-
+    # priority entries churn through: the dead middle compacts.
+    q2 = AdmissionQueue(capacity=4, clock=lambda: t[0],
+                        promote_after_s=None)
+    assert q2.push("stuck", priority=9)
+    for i in range(500):
+        assert q2.push(i, priority=0)
+        entry, _ = q2.pop()
+        assert entry.request == i
+    assert len(q2) == 1  # "stuck" still waiting (promotion disabled)
+    assert len(q2._fifo) <= 16
+    assert len(q2._heap) <= 16
+    entry, _ = q2.pop()
+    assert entry.request == "stuck"
+
+
 # ---------------------------------------------------------------------------
 # Load-generator-driven tests (slow tier: wall-clock assertions).
 # ---------------------------------------------------------------------------
@@ -466,3 +928,28 @@ def test_serve_load_open_loop_sheds_under_overload():
     assert stats["completed"] + stats["shed"] == 24
     assert stats["shed"] > 0
     assert stats["tokens_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_serve_load_replica_scaling_and_slo_overload():
+    """The router acceptance criteria as measured: >= 1.7x tokens/sec
+    at 2 replicas on the ragged mix (run_replica_sweep asserts it),
+    int8 paged KV >= 1.8x resident slots per byte (kv_capacity_report
+    asserts it), and under open-loop overload the router sheds via SLO
+    burn — zero capacity sheds — with admitted p99 TTFT inside the
+    objective (run_router_overload asserts all three)."""
+    from benchmarks.serve_load import (
+        kv_capacity_report,
+        run_replica_sweep,
+        run_router_overload,
+    )
+
+    cap = kv_capacity_report()
+    assert cap["int8_slots_per_byte_x"] >= 1.8
+    sweep = run_replica_sweep(replica_counts=(1, 2))
+    two = next(s for s in sweep["sweep"] if s["replicas"] == 2)
+    assert two["scaling_x"] >= 1.7
+    over = run_router_overload()
+    assert over["finish_reasons"].get("shed_slo", 0) > 0
+    assert over["finish_reasons"].get("shed_capacity", 0) == 0
+    assert over["ttft"]["p99_ms"] <= over["ttft_objective_ms"]

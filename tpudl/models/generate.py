@@ -65,6 +65,38 @@ def decode_fn(model):
     return fn
 
 
+def paged_decode_fn(model, page_size: int, quantized: bool):
+    """THE paged single-token decode contract (tpudl.models.paged):
+    ``(params, cache, token, position, page_table, start, lens) ->
+    (logits, new_cache)`` where ``cache`` holds per-layer page pools
+    (``pages_k``/``pages_v`` + ``scale_k``/``scale_v`` when int8) and
+    the three small int32 arrays are the HOST-owned addressing state —
+    page table [B, P], first attendable logical position [B], and the
+    logical write position [B]. ``page_size``/``quantized`` are static
+    (baked into the compiled program); placement changes never
+    recompile. Built for the serve engine's paged mode
+    (tpudl.serve.cache.PagedKVCache owns the pools and addressing)."""
+    from tpudl.models.paged import PagedView
+
+    def fn(params, cache, token, position, page_table, start, lens):
+        view = PagedView(
+            page_table=page_table, start=start, lens=lens,
+            page_size=page_size, quantized=quantized,
+        )
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            token[:, None],
+            jnp.ones_like(token)[:, None],
+            decode=True,
+            positions=position[:, None],
+            paged=view,
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return fn
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _prefill(model, params, input_ids, attention_mask):
     return prefill_fn(model)(params, input_ids, attention_mask)

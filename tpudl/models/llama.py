@@ -174,11 +174,22 @@ def _gqa_decode_attention(q, k, v, mask):
     return ctx.reshape(b, s, h, d)
 
 
+def _paged_cache_missing():
+    raise ValueError(
+        "paged decode requires a provided 'cache' collection (the page "
+        "pools tpudl.serve.cache.PagedKVCache builds) — there is no "
+        "shape information to initialize one here"
+    )
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions, kv_mask=None, decode: bool = False):
+    def __call__(
+        self, hidden, positions, kv_mask=None, decode: bool = False,
+        paged=None,
+    ):
         cfg = self.cfg
         B, S, _ = hidden.shape
         hd = cfg.head_dim
@@ -190,6 +201,51 @@ class LlamaAttention(nn.Module):
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
+
+        if decode and paged is not None:
+            # Paged decode (tpudl.models.paged): KV lives in page pools
+            # addressed by the host-provided page table instead of the
+            # dense [B, max_seq] rows below — each slot has its OWN
+            # length (no shared write index, so no horizon rollover)
+            # and pools may store int8 with per-(page, row, head)
+            # dequant scales fused into the gather. Single-token steps
+            # only: prefill stays dense batch-1 (its row cache is
+            # scattered into pages by PagedKVCache.seat).
+            from tpudl.models.paged import (
+                paged_attend_mask,
+                paged_gather,
+                paged_write,
+            )
+
+            if S != 1:
+                raise ValueError(
+                    f"paged decode is single-token (got chunk length "
+                    f"{S}); prefill runs through the dense batch-1 path"
+                )
+            pk = self.variable("cache", "pages_k", _paged_cache_missing)
+            pv = self.variable("cache", "pages_v", _paged_cache_missing)
+            sk = sv = None
+            if paged.quantized:
+                sk = self.variable("cache", "scale_k", _paged_cache_missing)
+                sv = self.variable("cache", "scale_v", _paged_cache_missing)
+            new_k, new_sk = paged_write(
+                pk.value, sk.value if sk is not None else None, k[:, 0], paged
+            )
+            new_v, new_sv = paged_write(
+                pv.value, sv.value if sv is not None else None, v[:, 0], paged
+            )
+            pk.value, pv.value = new_k, new_v
+            if paged.quantized:
+                sk.value, sv.value = new_sk, new_sv
+            kf = paged_gather(
+                pk.value, sk.value if sk is not None else None, paged, k.dtype
+            )
+            vf = paged_gather(
+                pv.value, sv.value if sv is not None else None, paged, v.dtype
+            )
+            ctx = _gqa_decode_attention(q, kf, vf, paged_attend_mask(paged))
+            ctx = ctx.reshape(B, S, cfg.num_heads * hd)
+            return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
 
         if decode:
             # KV cache (flax decode idiom): static [B, max_seq, Hkv, D]
@@ -275,7 +331,10 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, positions, kv_mask=None, decode: bool = False):
+    def __call__(
+        self, hidden, positions, kv_mask=None, decode: bool = False,
+        paged=None,
+    ):
         cfg = self.cfg
         from tpudl.ops.norms import fused_ops_impl
 
@@ -285,6 +344,7 @@ class LlamaBlock(nn.Module):
             positions,
             kv_mask,
             decode,
+            paged,
         )
         # The attention residual add rides inside the post-attention
         # norm kernel; the summed value comes back as the carried
@@ -324,7 +384,8 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(
-        self, input_ids, attention_mask=None, decode=False, positions=None
+        self, input_ids, attention_mask=None, decode=False, positions=None,
+        paged=None,
     ):
         cfg = self.cfg
         # kv_mask=None keeps the unpadded fast path (no in-kernel validity
@@ -350,7 +411,9 @@ class LlamaModel(nn.Module):
         if cfg.remat and not decode:
             block = nn.remat(LlamaBlock, static_argnums=(4,))
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions, kv_mask, decode)
+            x = block(cfg, name=f"layer_{i}")(
+                x, positions, kv_mask, decode, paged
+            )
         from tpudl.ops.norms import fused_ops_impl
 
         return RMSNorm(
@@ -364,10 +427,11 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(
-        self, input_ids, attention_mask=None, decode=False, positions=None
+        self, input_ids, attention_mask=None, decode=False, positions=None,
+        paged=None,
     ):
         x = LlamaModel(self.cfg, name="model")(
-            input_ids, attention_mask, decode, positions
+            input_ids, attention_mask, decode, positions, paged
         )
         logits = nn.Dense(
             self.cfg.vocab_size,

@@ -1,0 +1,157 @@
+"""Paged KV-cache primitives: page pools, gather/scatter, int8 quant.
+
+The dense decode cache (tpudl.models.llama.LlamaAttention decode mode)
+allocates ``[num_slots, max_seq_len, Hkv, D]`` per layer whether a slot
+holds a 14-token short request or a 256-token horizon-filler, and every
+slot shares ONE device-side write index — the source of the serve
+engine's horizon rollovers. The paged layout replaces both:
+
+- KV lives in a pool of fixed-size **pages** ``[num_pages, page_size,
+  Hkv, D]`` per layer; a slot owns whichever pages its **page table**
+  row ``page_table[slot, j]`` maps (logical page ``j`` -> physical page
+  id). Memory scales with what requests actually reserve, not with
+  ``num_slots x max_seq_len``.
+- Each slot carries its OWN length (``lens[slot]``) — decode writes
+  row ``b`` at its own logical position, so no horizon is shared and
+  rollovers cease to exist.
+- Pages optionally store **int8** with a dequant scale per (page, row,
+  kv-head) — ~4x the resident tokens per byte vs f32 pools — applied
+  inside the decode gather (one fused multiply on the gathered view).
+
+Masking: slot ``b`` attends logical positions ``[start[b], lens[b]]``
+(``start`` = its left-pad count, ``lens`` = where this step's token was
+just written). Physical page ids play no role in masking — the page
+table is pure address translation, updated on the HOST between steps
+(it rides into the decode program as a small traced input, so seating
+and freeing slots never recompiles anything).
+
+Physical page 0 is reserved as the **trash page**: freed slots' table
+rows point at it, so an idle slot's ride-along decode write lands in a
+page no live slot ever maps — the paged analog of the dense cache's
+"stale rows are masked" contract.
+
+The serving-side pool manager is tpudl.serve.cache.PagedKVCache; the
+decode program contract is tpudl.models.generate.paged_decode_fn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+#: Symmetric int8 range: quantized values live in [-127, 127].
+INT8_MAX = 127.0
+#: Floor on quantization scales so an all-zero row dequantizes to zeros
+#: instead of dividing by zero.
+SCALE_EPS = 1e-12
+
+
+@dataclasses.dataclass
+class PagedView:
+    """Per-dispatch paged-cache addressing, threaded through the model.
+
+    ``page_table`` ([B, P] int32) maps slot b's logical page j to a
+    physical pool page (0 = the trash page for unmapped entries);
+    ``start`` ([B] int32) is slot b's first attendable logical position
+    (its left-pad count); ``lens`` ([B] int32) is the logical position
+    this step's token is written at. ``page_size`` and ``quantized``
+    are STATIC (baked into the compiled program); the arrays are traced
+    inputs, so the host mutates placement freely between dispatches.
+    """
+
+    page_table: jax.Array
+    start: jax.Array
+    lens: jax.Array
+    page_size: int
+    quantized: bool
+
+    @property
+    def logical_len(self) -> int:
+        """Positions addressable per slot: pages_per_slot x page_size."""
+        return int(self.page_table.shape[1]) * self.page_size
+
+
+def quantize_kv(x: jax.Array):
+    """Symmetric int8 quantization over the head_dim axis.
+
+    ``x`` [..., Hkv, D] -> (q int8 [..., Hkv, D], scale f32 [..., Hkv]);
+    ``q * scale`` reconstructs x to ~0.4% of the per-head max — the
+    granularity that keeps greedy decode token-stable at tiny scales
+    while costing 4/D extra bytes per element (scale rows ride in the
+    pool next to their page)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / INT8_MAX
+    scale = jnp.maximum(scale, SCALE_EPS)
+    q = jnp.round(xf / scale[..., None])
+    q = jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def paged_write(
+    pages: jax.Array,
+    scales: Optional[jax.Array],
+    value: jax.Array,
+    view: PagedView,
+):
+    """Write one token's KV per slot into its current page row.
+
+    ``pages`` [NP, ps, Hkv, D] (int8 or compute dtype), ``scales``
+    [NP, ps, Hkv] f32 (quantized pools only), ``value`` [B, Hkv, D]
+    (the freshly projected + RoPE'd k or v). Slot b lands at physical
+    ``(page_table[b, lens[b] // ps], lens[b] % ps)``; idle slots (lens
+    pinned at 0 on a trash-mapped row) write into page 0, which no live
+    slot maps."""
+    b = value.shape[0]
+    ps = view.page_size
+    page = jnp.take_along_axis(
+        view.page_table, (view.lens // ps)[:, None], axis=1
+    )[:, 0]
+    off = view.lens % ps
+    if view.quantized:
+        q, s = quantize_kv(value)
+        pages = pages.at[page, off].set(q)
+        scales = scales.at[page, off].set(s)
+    else:
+        pages = pages.at[page, off].set(value.astype(pages.dtype))
+    return pages, scales
+
+
+def paged_gather(
+    pages: jax.Array,
+    scales: Optional[jax.Array],
+    view: PagedView,
+    compute_dtype,
+) -> jax.Array:
+    """Materialize every slot's logical KV view from the pool.
+
+    Returns [B, L, Hkv, D] in ``compute_dtype`` where L = pages_per_slot
+    x page_size; dequantization (``q * scale``) is fused into this
+    gather for int8 pools. Unmapped logical pages resolve to the trash
+    page — finite garbage the attention mask excludes."""
+    np_, ps = pages.shape[0], view.page_size
+    bsz, p = view.page_table.shape
+    # flat physical index per (slot, logical position):
+    # page_table[b, j] * ps + offset.
+    flat_idx = (
+        view.page_table[:, :, None] * ps
+        + jnp.arange(ps, dtype=view.page_table.dtype)[None, None, :]
+    ).reshape(bsz, p * ps)
+    flat_pages = pages.reshape(np_ * ps, *pages.shape[2:])
+    out = flat_pages[flat_idx]  # [B, L, Hkv, D]
+    if view.quantized:
+        flat_scales = scales.reshape(np_ * ps, scales.shape[2])
+        out = out.astype(jnp.float32) * flat_scales[flat_idx][..., None]
+    return out.astype(compute_dtype)
+
+
+def paged_attend_mask(view: PagedView) -> jax.Array:
+    """[B, 1, 1, L] bool — attend logical positions in
+    [start, lens] inclusive (lens = the just-written current token)."""
+    pos = jnp.arange(view.logical_len)
+    mask = (pos[None, :] >= view.start[:, None]) & (
+        pos[None, :] <= view.lens[:, None]
+    )
+    return mask[:, None, None, :]

@@ -42,7 +42,27 @@ from tpudl.ops.norms import resolve_impl
 from tpudl.ops.pallas_utils import COMPILER_PARAMS, round_up
 
 
+#: Override for the vocab-block cap below (None = the 1024 default).
+#: ``benchmarks/fused_epilogue.py --sweep-blocks`` grid-searches this;
+#: ``TPUDL_CE_VOCAB_BLOCK`` pins a tuned winner for production runs.
+#: The divisibility walk still applies, so any override stays legal.
+VOCAB_BLOCK_OVERRIDE: Optional[int] = None
+
+
 def _fit_vocab_block(v_pad: int, limit: int = 1024) -> int:
+    override = VOCAB_BLOCK_OVERRIDE
+    if override is None:
+        import os
+
+        raw = os.environ.get("TPUDL_CE_VOCAB_BLOCK")
+        if raw:
+            override = int(raw)
+    if override is not None:
+        if override < 128:
+            raise ValueError(
+                f"vocab-block override must be >= 128, got {override}"
+            )
+        limit = override
     b = min(limit, v_pad)
     while b > 128 and v_pad % b != 0:
         b //= 2

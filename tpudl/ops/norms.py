@@ -77,9 +77,29 @@ def fused_ops_impl(flag) -> str:
     return "auto"
 
 
+#: Override for the row-block heuristic below (None = heuristic).
+#: ``benchmarks/fused_epilogue.py --sweep-blocks`` grid-searches this;
+#: ``TPUDL_NORM_BLOCK_ROWS`` pins a tuned winner for production runs.
+#: Shared by the MLP epilogues too (they grid through ``_grid_setup``).
+BLOCK_ROWS_OVERRIDE: Optional[int] = None
+
+
 def _block_rows(n: int, h_pad: int, itemsize: int) -> int:
     """Row-block height: sublane-aligned (16 covers bf16's min tile),
     capped so one (rows, h_pad) block stays ~1 MB."""
+    override = BLOCK_ROWS_OVERRIDE
+    if override is None:
+        import os
+
+        raw = os.environ.get("TPUDL_NORM_BLOCK_ROWS")
+        if raw:
+            override = int(raw)
+    if override is not None:
+        if override < 1:
+            raise ValueError(
+                f"block-rows override must be >= 1, got {override}"
+            )
+        return min(round_up(override, 16), round_up(n, 16))
     cap = max(16, ((1 << 20) // max(h_pad * itemsize, 1)) // 16 * 16)
     return min(256, cap, round_up(n, 16))
 

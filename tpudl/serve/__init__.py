@@ -1,22 +1,32 @@
 """L4+ request-level serving: continuous batching over the compiled
-decode path.
+decode path, scaled out by a multi-replica router.
 
 The reference repo's substance is export -> session -> infer on single
 inputs (reference notebooks/cv/onnx_experiments.py); this package is
 what sits between that and "serve heavy traffic": a bounded admission
-queue (tpudl.serve.queue), a fixed-slot KV cache manager
-(tpudl.serve.cache), a continuous-batching engine multiplexing many
-requests onto the two compiled XLA programs (tpudl.serve.engine), and a
-synchronous Request/Result front end that serves either a live model or
-a deserialized StableHLO artifact (tpudl.serve.api).
+queue (tpudl.serve.queue), KV cache managers — the dense fixed-slot
+layout and its paged + optionally int8-quantized successor
+(tpudl.serve.cache) — a continuous-batching engine multiplexing many
+requests onto the compiled XLA programs (tpudl.serve.engine), a
+synchronous Request/Result front end with token streaming that serves
+either a live model or a deserialized StableHLO artifact
+(tpudl.serve.api), and a load-balancing router over N engine replicas
+with prefill/decode disaggregation and SLO-aware shedding
+(tpudl.serve.router).
 """
 
 from tpudl.serve.api import (  # noqa: F401
     Request,
     Result,
     ServeSession,
+    StreamChunk,
     assert_serving_parity,
 )
-from tpudl.serve.cache import SlotCache  # noqa: F401
+from tpudl.serve.cache import PagedKVCache, SlotCache  # noqa: F401
 from tpudl.serve.engine import Engine  # noqa: F401
 from tpudl.serve.queue import AdmissionQueue  # noqa: F401
+from tpudl.serve.router import (  # noqa: F401
+    PrefillWorker,
+    Replica,
+    Router,
+)

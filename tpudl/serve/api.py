@@ -22,8 +22,16 @@ deadline produces a ``Result`` with finish_reason ``shed_capacity`` /
 ``shed_timeout``.
 
 Knobs: ``TPUDL_SERVE_SLOTS`` (default slot count for ``from_model``,
-artifact sessions carry theirs in the decode program's batch dim) and
-``TPUDL_SERVE_QUEUE_DEPTH`` (admission queue capacity).
+artifact sessions carry theirs in the decode program's batch dim),
+``TPUDL_SERVE_QUEUE_DEPTH`` (admission queue capacity),
+``TPUDL_SERVE_PAGED`` / ``TPUDL_SERVE_PAGE_SIZE`` /
+``TPUDL_SERVE_KV_DTYPE`` (paged KV layout + optional int8 storage for
+``from_model`` — see tpudl.serve.cache.PagedKVCache).
+
+Streaming: ``session.stream(requests)`` yields ``StreamChunk``s as
+tokens are selected (the router's per-request streaming feed) instead
+of collect-at-eos; a request's concatenated chunk tokens are
+byte-identical to the ``Result.tokens`` submit/collect returns.
 """
 
 from __future__ import annotations
@@ -31,6 +39,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -60,6 +69,10 @@ class Request:
     seed: int = 0
     priority: int = 0
     deadline_s: Optional[float] = None
+    #: Sticky-placement key for the multi-replica router: requests
+    #: sharing a session_key land on the same replica (prefix/KV
+    #: affinity). None = place purely by load.
+    session_key: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -79,6 +92,58 @@ class Result:
     @property
     def ok(self) -> bool:
         return self.finish_reason in ("eos", "length")
+
+
+@dataclasses.dataclass
+class StreamChunk:
+    """One increment of a streamed request: ``tokens`` selected since
+    the previous chunk. The last chunk has ``done=True`` and carries
+    the final ``Result`` (whose ``tokens`` are the full sequence — the
+    authoritative value; concatenated chunk tokens equal it exactly).
+    Shed requests stream a single empty ``done`` chunk."""
+
+    request_id: Any
+    tokens: List[int]
+    done: bool
+    result: Optional[Result] = None
+
+
+def validate_request(request: Request, prompt_len: int, max_seq_len: int) -> None:
+    """Admission validation shared by ``ServeSession.submit`` and the
+    router: raise ValueError for a request that can never be served at
+    the compiled shapes. A bad request must be rejected at the door —
+    admitted past it, it would kill a prefill worker thread or block an
+    engine's disaggregation inbox forever."""
+    n = len(request.input_ids)
+    if n < 1:
+        raise ValueError("input_ids must hold at least one token")
+    if n > prompt_len:
+        raise ValueError(
+            f"prompt length {n} exceeds the session's compiled "
+            f"prompt window {prompt_len} (rejected at admission)"
+        )
+    if request.max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {request.max_new_tokens}"
+        )
+    if prompt_len + request.max_new_tokens > max_seq_len:
+        raise ValueError(
+            f"prompt window ({prompt_len}) + max_new_tokens "
+            f"({request.max_new_tokens}) exceeds max_seq_len "
+            f"{max_seq_len} (the KV-cache bound) — rejected at "
+            f"admission"
+        )
+    if request.temperature < 0.0:
+        raise ValueError(
+            f"temperature must be >= 0, got {request.temperature}"
+        )
+    if not 0 <= request.seed < 2**32:
+        # The engine carries seeds as uint32; an out-of-range seed
+        # would raise mid-serving (stranding every in-flight request)
+        # instead of here at admission.
+        raise ValueError(
+            f"seed must fit uint32 [0, 2**32), got {request.seed}"
+        )
 
 
 def _env_int(name: str, default: int) -> int:
@@ -108,6 +173,7 @@ class ServeSession:
         clock: Callable[[], float] = time.monotonic,
         continuous: bool = True,
         slo=None,
+        cache=None,
     ):
         # Deferred import: engine imports Request/Result from this
         # module.
@@ -118,7 +184,8 @@ class ServeSession:
         # exposes /metrics, /healthz (engine slots/queue + SLO burn
         # state), and /snapshot while it runs.
         obs_exporter.maybe_start_from_env()
-        cache = SlotCache(cache_template)
+        if cache is None:
+            cache = SlotCache(cache_template)
         self.queue = AdmissionQueue(
             capacity=queue_capacity
             if queue_capacity is not None
@@ -136,6 +203,12 @@ class ServeSession:
             self.engine.attach_slo(slo)
             slo.register_as_health_source()
         self._pending_ids: set = set()
+        #: Weakref to the live stream() generator — lets stream()
+        #: distinguish an ACTIVE stream (raise) from a generator that
+        #: was abandoned before its first iteration (a never-started
+        #: frame runs no ``finally``, so only this reference can
+        #: reclaim the engine's token feed).
+        self._stream_gen = None
 
     # -- constructors --------------------------------------------------
 
@@ -146,13 +219,31 @@ class ServeSession:
         params,
         prompt_len: int,
         num_slots: Optional[int] = None,
+        paged: Optional[bool] = None,
+        page_size: Optional[int] = None,
+        kv_dtype: Optional[str] = None,
+        num_pages: Optional[int] = None,
         **kwargs,
     ) -> "ServeSession":
         """Live-model session: jit the prefill/decode contracts (batch 1
         and batch ``num_slots`` respectively) and derive the cache
         template by abstract evaluation — nothing compiles until the
-        first request."""
-        from tpudl.models.generate import decode_fn, prefill_fn
+        first request.
+
+        ``paged=True`` (or ``TPUDL_SERVE_PAGED=1``) swaps the dense
+        fixed-slot cache for the paged layout (per-slot page tables, no
+        shared write horizon, so no rollovers); ``kv_dtype="int8"`` (or
+        ``TPUDL_SERVE_KV_DTYPE=int8``) additionally stores pages
+        quantized with per-(page, row, head) dequant scales fused into
+        the decode gather — ~4x the resident slots per byte.
+        ``page_size`` (``TPUDL_SERVE_PAGE_SIZE``, default 16) and
+        ``num_pages`` (default: capacity parity with the dense cache)
+        size the pool."""
+        from tpudl.models.generate import (
+            decode_fn,
+            paged_decode_fn,
+            prefill_fn,
+        )
 
         num_slots = (
             num_slots
@@ -161,12 +252,43 @@ class ServeSession:
         )
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if paged is None:
+            paged = os.environ.get("TPUDL_SERVE_PAGED", "") in (
+                "1", "true", "yes"
+            )
         pf = prefill_fn(model)
         ids = jax.ShapeDtypeStruct((num_slots, prompt_len), jnp.int32)
         _, cache_template = jax.eval_shape(pf, params, ids, ids)
+        if paged:
+            from tpudl.serve.cache import PagedKVCache
+
+            if kv_dtype is None:
+                kv_dtype = os.environ.get("TPUDL_SERVE_KV_DTYPE") or None
+            cache = PagedKVCache(
+                cache_template,
+                page_size=(
+                    page_size
+                    if page_size is not None
+                    else _env_int("TPUDL_SERVE_PAGE_SIZE", 16)
+                ),
+                num_pages=num_pages,
+                kv_dtype=kv_dtype,
+            )
+            decode = jax.jit(
+                paged_decode_fn(model, cache.page_size, cache.quantized)
+            )
+        elif page_size is not None or kv_dtype is not None or (
+            num_pages is not None
+        ):
+            raise ValueError(
+                "page_size/kv_dtype/num_pages require paged=True"
+            )
+        else:
+            cache = None
+            decode = jax.jit(decode_fn(model))
         return cls(
-            jax.jit(pf), jax.jit(decode_fn(model)), params,
-            cache_template, prompt_len, **kwargs,
+            jax.jit(pf), decode, params,
+            cache_template, prompt_len, cache=cache, **kwargs,
         )
 
     @classmethod
@@ -229,36 +351,7 @@ class ServeSession:
         rid = request.request_id
         if rid in self._pending_ids or rid in self.engine.results:
             raise ValueError(f"duplicate request_id {rid!r}")
-        n = len(request.input_ids)
-        if n < 1:
-            raise ValueError("input_ids must hold at least one token")
-        if n > self.prompt_len:
-            raise ValueError(
-                f"prompt length {n} exceeds the session's compiled "
-                f"prompt window {self.prompt_len} (rejected at admission)"
-            )
-        if request.max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {request.max_new_tokens}"
-            )
-        if self.prompt_len + request.max_new_tokens > self.max_seq_len:
-            raise ValueError(
-                f"prompt window ({self.prompt_len}) + max_new_tokens "
-                f"({request.max_new_tokens}) exceeds max_seq_len "
-                f"{self.max_seq_len} (the KV-cache bound) — rejected at "
-                f"admission"
-            )
-        if request.temperature < 0.0:
-            raise ValueError(
-                f"temperature must be >= 0, got {request.temperature}"
-            )
-        if not 0 <= request.seed < 2**32:
-            # The engine carries seeds as uint32; an out-of-range seed
-            # would raise mid-serving (stranding every in-flight
-            # request) instead of here at admission.
-            raise ValueError(
-                f"seed must fit uint32 [0, 2**32), got {request.seed}"
-            )
+        validate_request(request, self.prompt_len, self.max_seq_len)
         self._pending_ids.add(rid)
         admitted = self.queue.push(
             request, priority=request.priority, deadline_s=request.deadline_s
@@ -289,6 +382,11 @@ class ServeSession:
             rid: self.engine.results.pop(rid) for rid in self._pending_ids
         }
         self._pending_ids.clear()
+        # collect() finishes work an abandoned stream() admitted; that
+        # generator never ran, so release its token feed here (a live
+        # generator releases its own and ignores this — it checks feed
+        # ownership before touching the engine).
+        self.engine.on_token = None
         rec = active_recorder()
         if rec is not None:
             rec.counters(registry().snapshot())
@@ -300,17 +398,131 @@ class ServeSession:
             self.submit(request)
         return self.collect()
 
+    def stream(
+        self,
+        requests: Sequence[Request] = (),
+        chunk_tokens: int = 1,
+    ):
+        """Incremental serving: submit ``requests`` (already-submitted
+        pending work streams too) and yield ``StreamChunk``s as tokens
+        are selected, interleaved across every in-flight request, until
+        all pending requests have completed. The final chunk per
+        request carries its ``Result``; concatenating a request's chunk
+        tokens reproduces ``Result.tokens`` exactly (same engine, same
+        selection — streaming changes delivery, not generation).
+
+        ``chunk_tokens`` batches the yield granularity (1 = one chunk
+        per token, the TTFT-faithful default). Validation, submission,
+        and claiming the engine's token feed all happen HERE at call
+        time (misuse — chunk_tokens=0, two concurrent streams — raises
+        at the call site, and requests are admitted even if the caller
+        abandons the generator un-iterated; collect() finishes them).
+        Only token delivery is lazy: breaking out mid-iteration leaves
+        undelivered work pending and releases the feed."""
+        if chunk_tokens < 1:
+            raise ValueError(
+                f"chunk_tokens must be >= 1, got {chunk_tokens}"
+            )
+        if self.engine.on_token is not None:
+            prior = self._stream_gen() if self._stream_gen else None
+            if prior is None or prior.gi_frame is None:
+                # The feed belongs to a stream() generator that can
+                # never release it: GC'd (weakref dead), or finished /
+                # close()d before its first iteration — gi_frame is
+                # None only once a generator completes, and closing an
+                # UNSTARTED generator finishes it without ever entering
+                # the try, so its ``finally`` never ran. Reclaim the
+                # feed; collect() finishes the work it admitted. (An
+                # alive, merely un-iterated generator keeps its claim —
+                # it can still be driven — and a second stream() then
+                # raises below.)
+                self.engine.on_token = None
+            else:
+                raise RuntimeError(
+                    "a stream() is already active on this session"
+                )
+        buf: Dict[Any, List[int]] = {}
+
+        def sink(rid, token):
+            buf.setdefault(rid, []).append(token)
+
+        self.engine.on_token = sink
+        try:
+            for request in requests:
+                self.submit(request)
+        except BaseException:
+            self.engine.on_token = None
+            raise
+        gen = self._stream_chunks(buf, chunk_tokens, sink)
+        self._stream_gen = weakref.ref(gen)
+        return gen
+
+    def _stream_chunks(
+        self, buf: Dict[Any, List[int]], chunk_tokens: int, sink
+    ):
+        """The lazy half of ``stream()`` (which owns validation and
+        submission): step the engine and yield chunks until every
+        pending request completes, then release the token feed — but
+        only while this generator still OWNS the feed (``sink``); a
+        stale generator whose feed was reclaimed stops silently rather
+        than stepping the engine under the new owner."""
+        try:
+            while self._pending_ids:
+                if self.engine.on_token is not sink:
+                    return
+                progressed = self.engine.step()
+                finished = [
+                    rid for rid in list(self._pending_ids)
+                    if rid in self.engine.results
+                ]
+                for rid in finished:
+                    result = self.engine.results.pop(rid)
+                    self._pending_ids.discard(rid)
+                    yield StreamChunk(
+                        rid, buf.pop(rid, []), True, result
+                    )
+                for rid, toks in list(buf.items()):
+                    if len(toks) >= chunk_tokens:
+                        buf[rid] = []
+                        yield StreamChunk(rid, toks, False, None)
+                if not progressed and not finished and self._pending_ids:
+                    raise RuntimeError(
+                        f"engine drained with requests still pending "
+                        f"(no Result for {sorted(map(str, self._pending_ids))})"
+                    )
+        finally:
+            if self.engine.on_token is sink:
+                self.engine.on_token = None
+        rec = active_recorder()
+        if rec is not None:
+            rec.counters(registry().snapshot())
+
 
 def assert_serving_parity(
     session: ServeSession,
     model,
     params,
     requests: Sequence[Request],
+    atol: Optional[float] = None,
 ) -> None:
     """Assert every GREEDY request's engine tokens match live
     ``generate()`` run on the request alone — the artifact-vs-live
     interchangeability check (a Result's tokens are the generate row up
-    to and including eos; generate pads with eos after)."""
+    to and including eos; generate pads with eos after).
+
+    ``atol=None`` (exact mode) demands token-for-token equality — the
+    f32 dense/paged contract. ``atol`` set is the QUANTIZED-cache
+    contract ("parity at tolerance"): an int8 KV cache perturbs logits
+    by a bounded dequantization error, so greedy argmax may flip — but
+    ONLY at a genuine near-tie. The check walks the tokens and, at the
+    first divergence, teacher-forces the reference sequence through the
+    model to measure how far the reference's choice beats the token the
+    engine ACTUALLY produced at that step: a margin
+    within ``atol`` is a legitimate quantization flip (the
+    autoregressive paths legitimately differ after it — comparison
+    stops); a wide margin means the cache returned wrong values and the
+    assert fires. A real paging/dequant bug diverges immediately at
+    wide margins, so the tolerance mode still catches it."""
     from tpudl.models.generate import generate
 
     results = session.serve(list(requests))
@@ -328,12 +540,36 @@ def assert_serving_parity(
             )
         )[0]
         got = np.asarray(res.tokens)
-        np.testing.assert_array_equal(
-            got, want[: got.shape[0]],
-            err_msg=f"request {req.request_id} diverged from generate()",
-        )
-        if req.eos_id is not None and got.shape[0] < want.shape[0]:
-            assert np.all(want[got.shape[0]:] == req.eos_id), (
-                f"request {req.request_id}: engine stopped at eos but "
-                f"generate() kept producing non-eos tokens"
+        if atol is None:
+            np.testing.assert_array_equal(
+                got, want[: got.shape[0]],
+                err_msg=f"request {req.request_id} diverged from "
+                        f"generate()",
             )
+            if req.eos_id is not None and got.shape[0] < want.shape[0]:
+                assert np.all(want[got.shape[0]:] == req.eos_id), (
+                    f"request {req.request_id}: engine stopped at eos "
+                    f"but generate() kept producing non-eos tokens"
+                )
+            continue
+        n = min(got.shape[0], want.shape[0])
+        mismatches = np.nonzero(got[:n] != want[:n])[0]
+        if mismatches.size == 0:
+            continue
+        t = int(mismatches[0])
+        # Teacher-force the reference path up to the diverging step and
+        # measure how contested the reference's choice actually was.
+        prompt = np.asarray(req.input_ids, np.int32)
+        prefix = np.concatenate([prompt, want[:t].astype(np.int32)])
+        logits = model.apply(
+            {"params": params}, jnp.asarray(prefix)[None, :]
+        )
+        last = np.asarray(logits[0, -1], np.float32)
+        margin = float(last[int(want[t])] - last[int(got[t])])
+        assert margin <= atol, (
+            f"request {req.request_id}: diverged from generate() at "
+            f"step {t} where the reference prefers token {want[t]} "
+            f"over the engine's {got[t]} by logit margin {margin:.4f} "
+            f"> atol={atol} — that is a cache bug, not a quantization "
+            f"near-tie"
+        )

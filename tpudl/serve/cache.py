@@ -29,7 +29,7 @@ batch-independent, so a refill is bit-invisible to the other slots
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -77,6 +77,9 @@ def _free_slot(cache, slot):
 class SlotCache:
     """Owns the engine's cache pytree and the slot bookkeeping on it.
 
+    ``paged = False``: this is the dense fixed-slot layout; see
+    ``PagedKVCache`` below for the paged + quantized successor.
+
     ``template`` is a cache pytree of arrays or ShapeDtypeStructs with
     leading dim ``num_slots`` (from ``jax.eval_shape`` of the prefill
     contract at the slot-batched shape, or from a deserialized decode
@@ -84,6 +87,9 @@ class SlotCache:
     all-invalid, which decode tolerates (an all-masked row softmaxes to
     uniform weights over finite mask values; its output is discarded).
     """
+
+    #: Marks the dense engine path (Engine branches on this).
+    paged = False
 
     def __init__(self, template: Any):
         self.cache = jax.tree.map(
@@ -182,3 +188,334 @@ class SlotCache:
 
                 return np.asarray(jnp.sum(leaf, axis=-1))
         raise AssertionError("unreachable: ctor checked a valid leaf")
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache
+# ---------------------------------------------------------------------------
+
+
+def _is_attn_cache(node) -> bool:
+    """A per-layer dense decode cache dict: the four leaves
+    LlamaAttention's decode branch declares."""
+    from collections.abc import Mapping
+
+    return isinstance(node, Mapping) and set(node) >= {
+        "k", "v", "valid", "index"
+    }
+
+
+def _map_attn_caches(tree, fn):
+    """Rebuild a cache pytree (nested Mappings) with every per-layer
+    attention cache dict replaced by ``fn(dict)`` — the surgery that
+    turns the dense eval_shape template into page pools, and pairs
+    pool/row layers during seating."""
+    from collections.abc import Mapping
+
+    if _is_attn_cache(tree):
+        return fn(tree)
+    if isinstance(tree, Mapping):
+        return {k: _map_attn_caches(v, fn) for k, v in tree.items()}
+    return tree
+
+
+def _zip_attn_caches(a, b, fn):
+    """Walk two structurally-parallel cache pytrees; replace each
+    per-layer pair with ``fn(a_dict, b_dict)`` (used to scatter a dense
+    prefill row cache into the matching layer's page pool)."""
+    from collections.abc import Mapping
+
+    if isinstance(a, Mapping) and ("pages_k" in a or _is_attn_cache(a)):
+        return fn(a, b)
+    if isinstance(a, Mapping):
+        return {k: _zip_attn_caches(v, b[k], fn) for k, v in a.items()}
+    return a
+
+
+class PagedKVCache:
+    """Paged + optionally int8-quantized successor to ``SlotCache``.
+
+    KV lives in per-layer page pools ``[num_pages, page_size, Hkv, D]``
+    (int8 with ``[num_pages, page_size, Hkv]`` f32 dequant scales when
+    ``kv_dtype="int8"``); a slot owns the pages its HOST-side page
+    table row maps. Three consequences the engine builds on:
+
+    - **No shared write index**: each slot carries its own length, so
+      the dense cache's horizon rollover (reset-the-world when the
+      shared index nears ``max_seq_len``) does not exist here.
+    - **Reservation-based admission**: ``seat`` reserves every page a
+      request could need (``ceil((prompt_len + max_new_tokens) /
+      page_size)``) up front, so a seated request can NEVER strand
+      mid-decode on an empty pool; ``fits_tokens`` is the admission
+      predicate.
+    - **Physical page 0 is the trash page**: freed/idle slots' table
+      rows point at it, so their ride-along decode writes land where no
+      live slot ever reads — the paged analog of "stale rows are
+      masked".
+
+    ``template`` is the SAME dense cache template ``ServeSession``
+    already derives (eval_shape of the prefill contract); the pools are
+    built by tree surgery on it, so the paged cache needs no new model
+    contract beyond ``paged_decode_fn``. Addressing state (page table,
+    per-slot start/len) is host-side numpy, shipped into each decode
+    dispatch as small traced inputs — seating and freeing never
+    recompile anything.
+    """
+
+    #: Marks the paged engine path (Engine branches on this).
+    paged = True
+
+    def __init__(
+        self,
+        template: Any,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        kv_dtype: Optional[str] = None,
+        max_target_len: Optional[int] = None,
+    ):
+        import numpy as np
+
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (store dtype) or 'int8', "
+                f"got {kv_dtype!r}"
+            )
+        valid_leaves = [
+            leaf
+            for leaf in jax.tree.leaves(
+                template, is_leaf=lambda x: hasattr(x, "shape")
+            )
+            if _is_valid_leaf(leaf)
+        ]
+        if not valid_leaves:
+            raise ValueError(
+                "cache template has no [num_slots, max_seq_len] bool "
+                "validity leaf — not a tpudl decode cache"
+            )
+        self.num_slots = int(valid_leaves[0].shape[0])
+        self.model_seq_len = int(valid_leaves[0].shape[1])
+        self.page_size = int(page_size)
+        self.quantized = kv_dtype == "int8"
+        cap = max_target_len if max_target_len is not None else (
+            self.model_seq_len
+        )
+        if cap > self.model_seq_len:
+            raise ValueError(
+                f"max_target_len {cap} exceeds the model's compiled "
+                f"sequence bound {self.model_seq_len}"
+            )
+        self.pages_per_slot = -(-cap // self.page_size)
+        if num_pages is None:
+            # Capacity parity with the dense cache by default (+1 trash
+            # page); overcommit or shrink via explicit num_pages.
+            num_pages = self.num_slots * self.pages_per_slot + 1
+        if num_pages < 2 + self.pages_per_slot - 1:
+            raise ValueError(
+                f"num_pages={num_pages} cannot hold even one slot "
+                f"(pages_per_slot={self.pages_per_slot} + trash page)"
+            )
+        self.num_pages = int(num_pages)
+
+        def to_pool(attn: dict) -> dict:
+            k, v = attn["k"], attn["v"]
+            hkv, hd = int(k.shape[2]), int(k.shape[3])
+            store = jnp.int8 if self.quantized else k.dtype
+            pool = {
+                "pages_k": jnp.zeros(
+                    (self.num_pages, self.page_size, hkv, hd), store
+                ),
+                "pages_v": jnp.zeros(
+                    (self.num_pages, self.page_size, hkv, hd),
+                    jnp.int8 if self.quantized else v.dtype,
+                ),
+            }
+            if self.quantized:
+                pool["scale_k"] = jnp.zeros(
+                    (self.num_pages, self.page_size, hkv), jnp.float32
+                )
+                pool["scale_v"] = jnp.zeros(
+                    (self.num_pages, self.page_size, hkv), jnp.float32
+                )
+            return pool
+
+        self.cache = _map_attn_caches(template, to_pool)
+        # Host-owned addressing: page 0 is the trash page, never
+        # allocated; unmapped table entries point at it.
+        self._free: list = list(range(1, self.num_pages))
+        self._reserved: dict = {}
+        self.page_table = np.zeros(
+            (self.num_slots, self.pages_per_slot), np.int32
+        )
+        self.start = np.zeros((self.num_slots,), np.int32)
+        self.lens = np.zeros((self.num_slots,), np.int32)
+        self._seat_jit = {}
+
+    # -- capacity ------------------------------------------------------
+
+    @property
+    def max_seq_len(self) -> int:
+        """Logical positions addressable per slot — the admission bound
+        (prompt window + max_new_tokens must fit). Clamped to the
+        model's compiled bound: a page_size that does not divide it
+        rounds the page span up, but positions past ``model_seq_len``
+        do not exist in the decode program's position space."""
+        return min(self.pages_per_slot * self.page_size, self.model_seq_len)
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def fits_tokens(self, tokens: int) -> bool:
+        """Admission predicate: can a request that may write ``tokens``
+        logical positions be seated right now? Reservation up front
+        means yes here == never strands mid-decode."""
+        return self.pages_needed(tokens) <= len(self._free)
+
+    # -- seating / freeing ---------------------------------------------
+
+    def seat(
+        self,
+        row_cache: Any,
+        slot: int,
+        pad: int,
+        prompt_len: int,
+        reserve_tokens: int,
+    ) -> None:
+        """Reserve pages for ``reserve_tokens`` logical positions and
+        scatter a batch-1 dense prefill row cache's prompt region
+        (``[0, prompt_len)``, quantizing if int8) into the first pages.
+        ``pad`` is the row's left-pad count — logical positions below
+        it stay masked, exactly like dense validity."""
+        if not 0 <= slot < self.num_slots:
+            raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
+        if slot in self._reserved:
+            raise ValueError(f"slot {slot} is already seated")
+        if reserve_tokens > self.max_seq_len:
+            raise ValueError(
+                f"reserve_tokens {reserve_tokens} exceeds the logical "
+                f"per-slot bound {self.max_seq_len}"
+            )
+        n = self.pages_needed(reserve_tokens)
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n} pages, {len(self._free)} "
+                f"free (admission should have checked fits_tokens)"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._reserved[slot] = pages
+        self.page_table[slot, :] = 0
+        self.page_table[slot, : len(pages)] = pages
+        self.start[slot] = pad
+        self.lens[slot] = prompt_len
+        prompt_pages = self.pages_needed(prompt_len)
+        fn = self._seat_jit.get(prompt_pages)
+        if fn is None:
+            fn = jax.jit(self._make_seat_fn(prompt_pages))
+            self._seat_jit[prompt_pages] = fn
+        self.cache = fn(
+            self.cache, row_cache,
+            jnp.asarray(pages[:prompt_pages], jnp.int32),
+        )
+
+    def _make_seat_fn(self, prompt_pages: int):
+        """Build the jitted scatter: dense prefill row -> page pool.
+        One program per distinct prompt page count (in practice one —
+        the session's prompt window is fixed)."""
+        from tpudl.models.paged import quantize_kv
+
+        ps, quantized = self.page_size, self.quantized
+        span = prompt_pages * ps
+
+        def seat(pool_tree, row_tree, page_ids):
+            def one(pool: dict, row: dict) -> dict:
+                out = dict(pool)
+                for kv, name, sname in (
+                    ("k", "pages_k", "scale_k"),
+                    ("v", "pages_v", "scale_v"),
+                ):
+                    rowvals = row[kv]
+                    take = min(span, rowvals.shape[1])
+                    blocks = rowvals[0, :take]
+                    if take < span:
+                        # page_size doesn't divide the model bound: the
+                        # last prompt page extends past the dense row.
+                        # Zero-fill the tail — those logical positions
+                        # sit beyond prompt_len, so lens/validity masks
+                        # them until a decode write lands real values.
+                        blocks = jnp.pad(
+                            blocks,
+                            [(0, span - take)] + [(0, 0)] * (blocks.ndim - 1),
+                        )
+                    blocks = blocks.reshape(
+                        prompt_pages, ps, *rowvals.shape[2:]
+                    )
+                    if quantized:
+                        q, s = quantize_kv(blocks)
+                        out[name] = out[name].at[page_ids].set(q)
+                        out[sname] = out[sname].at[page_ids].set(s)
+                    else:
+                        out[name] = out[name].at[page_ids].set(
+                            blocks.astype(out[name].dtype)
+                        )
+                return out
+
+            return _zip_attn_caches(pool_tree, row_tree, one)
+
+        return seat
+
+    def free(self, slot: int) -> None:
+        """Return the slot's pages to the pool and point its table row
+        at the trash page (idle ride-along writes land there)."""
+        if not 0 <= slot < self.num_slots:
+            raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
+        pages = self._reserved.pop(slot, None)
+        if pages:
+            self._free.extend(pages)
+        self.page_table[slot, :] = 0
+        self.start[slot] = 0
+        self.lens[slot] = 0
+
+    def reset(self) -> None:
+        """Free every slot (the pool arrays keep their bytes — masked)."""
+        for slot in list(self._reserved):
+            self.free(slot)
+
+    # -- per-dispatch addressing ---------------------------------------
+
+    def dispatch_args(self):
+        """The three small traced inputs each paged decode dispatch
+        takes: (page_table [B, P], start [B], lens [B]) as int32."""
+        return (
+            jnp.asarray(self.page_table),
+            jnp.asarray(self.start),
+            jnp.asarray(self.lens),
+        )
+
+    def advance(self, slots) -> None:
+        """Advance the logical length of each ACTIVE slot after a
+        decode dispatch wrote its token (idle slots stay pinned at 0 on
+        the trash page)."""
+        for slot in slots:
+            self.lens[slot] += 1
+
+    # -- accounting ----------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        """Resident bytes: page pools (quantized values AND their scale
+        rows) plus the host-side page-table/start/len addressing — the
+        accurate number behind the ``serve_cache_bytes`` gauge (the
+        dense-dtype assumption would overstate int8 pools 4x and miss
+        the tables entirely)."""
+        device = int(
+            sum(leaf.nbytes for leaf in jax.tree.leaves(self.cache))
+        )
+        host = (
+            self.page_table.nbytes + self.start.nbytes + self.lens.nbytes
+        )
+        return device + host

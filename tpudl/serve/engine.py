@@ -24,14 +24,30 @@ Why mid-stream insertion is correct: see tpudl.serve.cache (slot-order
 + validity masking makes the new row see only its own prompt, and every
 per-row op is batch-independent, so neighbors are bit-unaffected).
 
-The one resource all slots share is the cache WRITE INDEX: the compiled
-decode writes every row at the same slot and advances it by one per
-step (LlamaAttention's scalar index), so the horizon ``max_seq_len -
-write_index`` shrinks monotonically for everyone. The engine therefore
-(a) only seats a request whose max_new_tokens fits the remaining
-horizon, and (b) when the batch drains with work still queued, RESETS
-the cache to recover the full horizon (a "rollover" — the paged-KV
-successor removes this cost by recycling slots piecewise).
+The one resource all slots share — in DENSE mode — is the cache WRITE
+INDEX: the compiled decode writes every row at the same slot and
+advances it by one per step (LlamaAttention's scalar index), so the
+horizon ``max_seq_len - write_index`` shrinks monotonically for
+everyone. The engine therefore (a) only seats a request whose
+max_new_tokens fits the remaining horizon, and (b) when the batch
+drains with work still queued, RESETS the cache to recover the full
+horizon (a "rollover").
+
+In PAGED mode (``cache.paged`` — a tpudl.serve.cache.PagedKVCache over
+tpudl.models.paged pools) there is no shared index: each slot carries
+its own length and decode writes through a host-owned page table, so
+rollovers cease to exist and admission is ``fits_tokens`` (are enough
+free pages left to reserve the request's worst case up front). The
+decode contract grows three small traced inputs
+(``paged_decode_fn``: page table + start + lens); everything else —
+mid-stream seating, selection, sampling, telemetry — is identical.
+
+Two hooks the multi-replica router (tpudl.serve.router) builds on:
+``on_token`` (called per (request_id, token) as it is selected — the
+streaming feed) and ``prefill_inbox`` (externally prefilled requests:
+a dedicated prefill replica runs the batch-1 program and hands the row
+cache over; this engine only seats and decodes — prefill/decode
+disaggregation over the same mid-stream insertion contract).
 
 Sampling is per-request and batch-composition-independent: token ``t``
 of a request is drawn with ``fold_in(key(request.seed), t)``, so the
@@ -88,6 +104,43 @@ def _select_tokens(logits, temps, seeds, steps):
     return jnp.where(temps > 0, sampled, greedy)
 
 
+def first_token(logits, request) -> int:
+    """Select a request's FIRST token from its batch-1 prefill logits
+    (step 0 of its per-request sampling stream) — shared by the
+    engine's local seat path and the router's dedicated prefill
+    workers, so disaggregated serving draws identical tokens."""
+    if request.temperature > 0:
+        sel = _select_tokens(
+            logits,
+            np.float32([request.temperature]),
+            np.uint32([request.seed]),
+            np.int32([0]),
+        )
+    else:
+        sel = _select_greedy(logits)
+    return int(np.asarray(sel)[0])
+
+
+class _Prefilled:
+    """One externally prefilled request awaiting a decode slot: the
+    handoff unit of prefill/decode disaggregation (built by the
+    router's PrefillWorker, drained by ``Engine._fill_slots``)."""
+
+    __slots__ = (
+        "entry", "row_cache", "first_token", "prompt_ids_len",
+        "t_popped", "t_first",
+    )
+
+    def __init__(self, entry: _Entry, row_cache: Any, first_token: int,
+                 prompt_ids_len: int, t_popped: float, t_first: float):
+        self.entry = entry
+        self.row_cache = row_cache
+        self.first_token = first_token
+        self.prompt_ids_len = prompt_ids_len
+        self.t_popped = t_popped  # queue wait ended here (prefill start)
+        self.t_first = t_first  # first token selected here (TTFT end)
+
+
 class _Slot:
     """Host-side state of one occupied decode slot."""
 
@@ -141,8 +194,20 @@ class Engine:
         self.max_seq_len = cache.max_seq_len
         self.clock = clock
         self.continuous = continuous
+        self.paged = bool(getattr(cache, "paged", False))
         self._slots: List[Optional[_Slot]] = [None] * self.num_slots
         self.results: Dict[Any, Result] = {}
+        # Streaming feed: called with (request_id, token) the moment a
+        # token is selected (prefill's first token included) — BEFORE
+        # the finish check, so a consumer sees eos arrive as a token
+        # and then the Result. ServeSession.stream() installs it.
+        self.on_token: Optional[Callable[[Any, int], None]] = None
+        # Disaggregation inbox: _Prefilled items seated by _fill_slots
+        # ahead of local queue pops (deque: appends are thread-safe, the
+        # router's prefill workers feed it from their own threads).
+        import collections
+
+        self.prefill_inbox = collections.deque()
         # Stat counters (also mirrored into the obs registry): decode
         # steps are the deterministic cost unit the static-vs-continuous
         # comparison uses (wall time rides on them 1:1 at fixed slots).
@@ -183,19 +248,26 @@ class Engine:
         (what the serve router's readiness and autoscale signals read).
         Burning SLO objectives surface via the monitor's own health
         source; here they only annotate the engine's view."""
-        return {
+        out = {
             "healthy": True,
             "slots_busy": sum(s is not None for s in self._slots),
             "num_slots": self.num_slots,
-            "queue_depth": len(self.queue),
+            "queue_depth": len(self.queue) + len(self.prefill_inbox),
             "queue_capacity": self.queue.capacity,
             "results_pending": len(self.results),
             "decode_steps": self.num_decode_steps,
             "prefills": self.num_prefills,
-            "write_index": self.cache.write_index,
             "max_seq_len": self.max_seq_len,
             "slo_burning": sorted(self._slo_burning),
+            "paged": self.paged,
         }
+        if self.paged:
+            out["free_pages"] = self.cache.free_pages
+            out["page_size"] = self.cache.page_size
+            out["kv_quantized"] = self.cache.quantized
+        else:
+            out["write_index"] = self.cache.write_index
+        return out
 
     def attach_slo(self, monitor) -> None:
         """Subscribe this engine's admission path to a
@@ -267,20 +339,8 @@ class Engine:
         rec = active_recorder()
         t0 = self.clock()
         logits, row_cache = self.prefill_call(self.params, padded, mask)
-        if req.temperature > 0:
-            sel = _select_tokens(
-                logits,
-                np.float32([req.temperature]),
-                np.uint32([req.seed]),
-                np.int32([0]),
-            )
-        else:
-            sel = _select_greedy(logits)
-        first = int(np.asarray(sel)[0])
-        self.cache.insert(row_cache, slot)
+        first = first_token(logits, req)
         now = self.clock()
-        queue_wait_ms = 1e3 * (t0 - entry.submitted_at)
-        ttft_ms = 1e3 * (now - entry.submitted_at)
         if rec is not None:
             # request_id on the prefill span is the trace link between
             # the queued event and this request's decode chunks.
@@ -288,13 +348,41 @@ class Engine:
                        {"slot": slot, "request_id": req.request_id,
                         "queue_wait_s": t0 - entry.submitted_at})
         self.num_prefills += 1
+        registry().counter("serve_prefills").inc()
+        self._install(entry, slot, row_cache, first, ids.shape[0], t0, now)
+
+    def _seat_prefilled(self, item: _Prefilled, slot: int) -> None:
+        """Seat a request a DEDICATED prefill replica already prefilled
+        (tpudl.serve.router disaggregation): same mid-stream insertion,
+        no local batch-1 dispatch — this engine only decodes."""
+        self._install(
+            item.entry, slot, item.row_cache, item.first_token,
+            item.prompt_ids_len, item.t_popped, item.t_first,
+        )
+
+    def _install(self, entry: _Entry, slot: int, row_cache: Any,
+                 first: int, ids_len: int, t_popped: float,
+                 t_first: float) -> None:
+        """Shared seat tail: cache insertion (dense scatter or paged
+        reservation+scatter), latency accounting, slot activation."""
+        req = entry.request
+        if self.paged:
+            self.cache.seat(
+                row_cache, slot, self.prompt_len - ids_len,
+                self.prompt_len, self.prompt_len + req.max_new_tokens,
+            )
+        else:
+            self.cache.insert(row_cache, slot)
+        queue_wait_ms = 1e3 * (t_popped - entry.submitted_at)
+        ttft_ms = 1e3 * (t_first - entry.submitted_at)
         reg = registry()
-        reg.counter("serve_prefills").inc()
         reg.histogram("serve_queue_wait_ms").observe(queue_wait_ms)
         reg.histogram("serve_ttft_ms").observe(ttft_ms)
         self._slo_observe("serve_queue_wait_ms", queue_wait_ms)
         self._slo_observe("serve_ttft_ms", ttft_ms)
-        self._slots[slot] = _Slot(entry, first, ids.shape[0], t0, now)
+        self._slots[slot] = _Slot(entry, first, ids_len, t_popped, t_first)
+        if self.on_token is not None:
+            self.on_token(req.request_id, first)
         # A request can finish on its very first token.
         self._maybe_finish(slot, first)
 
@@ -316,28 +404,56 @@ class Engine:
                 self._record_shed(self.queue.drain_all(), "shed_slo")
         if not self.continuous and self._active():
             return
-        if not self._active() and len(self.queue):
+        if (
+            not self.paged
+            and not self._active()
+            and (len(self.queue) or self.prefill_inbox)
+        ):
             # Batch drained with work queued: recover the full write
-            # horizon before seating the next wave.
+            # horizon before seating the next wave (dense only — paged
+            # slots recycle piecewise, there is no horizon to recover).
             if self.cache.write_index > self.prompt_len:
                 self.cache.reset()
                 self.num_rollovers += 1
                 registry().counter("serve_rollovers").inc()
+        # Externally prefilled requests (disaggregation) seat first:
+        # their prefill cost is already paid, a queue pop would re-pay
+        # it locally.
+        while self.prefill_inbox:
+            slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if slot is None:
+                break
+            if not self._fits(self.prefill_inbox[0].entry.request):
+                if self._fits_ever(self.prefill_inbox[0].entry.request):
+                    break  # fits once seated work frees capacity
+                # A never-fitting head (too big for even an EMPTY
+                # cache) would otherwise block every prefilled request
+                # behind it forever — the inbox is a plain deque with
+                # no deadline/skip path, unlike AdmissionQueue's
+                # fit-filtered pop. Shed it instead.
+                self._record_shed(
+                    [self.prefill_inbox.popleft().entry], "shed_capacity"
+                )
+                continue
+            self._seat_prefilled(self.prefill_inbox.popleft(), slot)
         while True:
             slot = next(
                 (i for i, s in enumerate(self._slots) if s is None), None
             )
             if slot is None:
                 break
-            base = max(self.cache.write_index, self.prompt_len)
-            entry, shed = self.queue.pop(
-                fit=lambda r: base + r.max_new_tokens <= self.max_seq_len
-            )
+            entry, shed = self.queue.pop(fit=self._fits)
             self._record_shed(shed, "shed_timeout")
             if entry is None:
                 break
             self._seat(entry, slot)
-        if self._active() and self.cache.write_index < self.prompt_len:
+        if (
+            not self.paged
+            and self._active()
+            and self.cache.write_index < self.prompt_len
+        ):
             # Fresh cache just seated its first wave: the batch-1 row
             # caches carried their own write indices (discarded by
             # insert); pin the shared index past the prompt region.
@@ -345,6 +461,29 @@ class Engine:
         registry().gauge("serve_slots_busy").set(
             sum(s is not None for s in self._slots)
         )
+
+    def _fits(self, request) -> bool:
+        """Can this request be seated RIGHT NOW? Dense: its worst case
+        fits the remaining shared write horizon. Paged: its worst case
+        fits the per-slot logical bound and enough pool pages are free
+        to reserve it up front (so it can never strand mid-decode)."""
+        if self.paged:
+            need = self.prompt_len + request.max_new_tokens
+            return need <= self.max_seq_len and self.cache.fits_tokens(need)
+        base = max(self.cache.write_index, self.prompt_len)
+        return base + request.max_new_tokens <= self.max_seq_len
+
+    def _fits_ever(self, request) -> bool:
+        """Could this request be seated in an EMPTY cache? False means
+        waiting can never help (the worst case exceeds the compiled
+        seq-len bound, or the paged pool is too small outright)."""
+        need = self.prompt_len + request.max_new_tokens
+        if need > self.max_seq_len:
+            return False
+        if self.paged:
+            # Page 0 is the trash page; an empty pool frees the rest.
+            return self.cache.pages_needed(need) <= self.cache.num_pages - 1
+        return True
 
     # -- stepping ------------------------------------------------------
 
@@ -396,8 +535,9 @@ class Engine:
 
     def _decode_step(self) -> None:
         """One slot-batched decode dispatch + selection + host readback;
-        idle slots ride along with zeros and their output is discarded."""
-        assert self.cache.write_index < self.max_seq_len, (
+        idle slots ride along with zeros and their output is discarded
+        (paged: idle rows write into the trash page)."""
+        assert self.paged or self.cache.write_index < self.max_seq_len, (
             "decode past the cache horizon would silently clamp writes "
             "(admission fit checks should make this unreachable)"
         )
@@ -417,14 +557,27 @@ class Engine:
             steps[i] = s.steps
         rec = active_recorder()
         t0 = self.clock()
-        logits, self.cache.cache = self.decode_call(
-            self.params, self.cache.cache, tokens, positions
-        )
+        if self.paged:
+            logits, self.cache.cache = self.decode_call(
+                self.params, self.cache.cache, tokens, positions,
+                *self.cache.dispatch_args(),
+            )
+        else:
+            logits, self.cache.cache = self.decode_call(
+                self.params, self.cache.cache, tokens, positions
+            )
         if temps.any():
             sel = np.asarray(_select_tokens(logits, temps, seeds, steps))
         else:
             sel = np.asarray(_select_greedy(logits))
-        self.cache.advance_write_index()  # host mirror of the +1 in-graph
+        if self.paged:
+            # Each ACTIVE slot's logical length advanced by one (idle
+            # slots stay pinned on the trash page).
+            self.cache.advance(
+                [i for i, s in enumerate(self._slots) if s is not None]
+            )
+        else:
+            self.cache.advance_write_index()  # host mirror of in-graph +1
         now = self.clock()
         if rec is not None:
             # "rids" names every request this decode chunk advanced —
@@ -444,6 +597,8 @@ class Engine:
             s.t_last = now
             tok = int(sel[i])
             s.tokens.append(tok)
+            if self.on_token is not None:
+                self.on_token(s.request.request_id, tok)
             self._maybe_finish(i, tok)
 
     def step(self) -> bool:

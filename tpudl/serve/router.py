@@ -1,0 +1,861 @@
+"""Multi-replica serving router: load balancing, disaggregation, SLO shed.
+
+One ServeSession is one engine over one (local) mesh. Serving "heavy
+traffic from millions of users" (ROADMAP north-star, item 2) needs N of
+them behind one front door. This module is that front door, built
+entirely from contracts earlier PRs shipped:
+
+- **Replica**: one ServeSession driven by its own thread (on a real
+  pod, one replica = one process mesh; in-process they are threads
+  whose device dispatches overlap). The thread drains an inbox,
+  steps the engine, harvests Results, and PUBLISHES a health snapshot —
+  the same payload the PR-6 ``/healthz`` endpoint serves under
+  ``sources.serve_engine``. The router reads that snapshot directly,
+  or SCRAPES it over HTTP (``health_url``) when the replica runs
+  behind a real exporter — replica choice is driven by scraped
+  slot/queue state either way.
+- **Placement**: sticky first (``Request.session_key`` pins a stream
+  of requests to one replica — KV/prefix affinity), then least-loaded
+  by scraped ``(slots_busy + queue_depth) / (num_slots +
+  queue_capacity)``. Unready replicas (scrape failed, 503, or
+  ``healthy: false``) take no new work.
+- **Failover**: when a replica goes unready mid-stream, every request
+  assigned to it that has not produced a Result is resubmitted to the
+  surviving replicas (generation restarts — KV is not migrated; greedy
+  requests produce identical tokens, sampled ones reproduce via the
+  per-request fold_in stream). Late results from a failed replica are
+  ignored: the assignment map names the one replica a Result is
+  accepted from.
+- **Prefill/decode disaggregation**: with ``PrefillWorker``s attached,
+  the router routes admitted requests through dedicated prefill
+  replicas (batch-1 program only) which hand ``(row cache, first
+  token)`` to the least-loaded DECODE replica's ``prefill_inbox`` —
+  the same mid-stream insertion contract continuous batching already
+  relies on. Decode replicas never pay a prefill dispatch between
+  decode steps, which is the TPOT win disaggregation exists for.
+- **SLO-aware admission**: the router subscribes every replica's
+  SloMonitor. While any objective burns, requests in the best-effort
+  class (``priority > shed_priority_above``) are shed AT THE ROUTER
+  (``shed_slo``) — latency-sensitive work keeps flowing to replicas
+  that are not burning — and the ``serve_router_autoscale_hint`` gauge
+  publishes the scale-out signal (burning replicas + unready
+  replicas): an autoscaler that adds replicas drives it back to 0.
+
+Observability: per-replica gauges (``serve_replica_<name>_slots_busy``
+/ ``_queue_depth`` / ``_ready``), ``serve_router_ready_replicas``,
+the autoscale hint, and ``serve_router_requests_{routed,failed_over}``
+counters; a ``serve_router`` health source reports ready/total (ready
+== 0 is unhealthy — the router itself should probe 503).
+
+Thread model: replica threads own their sessions EXCLUSIVELY; the
+router talks to them only through thread-safe deques and published
+snapshots, and does its own scraping/failover inline on a time gate
+inside submit()/poll()/collect() — no router-side polling thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from tpudl.obs import registry
+from tpudl.obs.spans import active_recorder
+from tpudl.serve.api import Request, Result, ServeSession, validate_request
+from tpudl.serve.queue import CAT_SERVE_REQUEST, _Entry
+
+
+def _metric_suffix(name: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in str(name))
+
+
+class Replica:
+    """One serving replica: a ServeSession plus the thread that drives
+    it. The session is touched ONLY by the replica thread; the router
+    communicates through ``submit()`` (thread-safe inbox), ``take()``
+    (harvested results), and ``scrape()`` (published health)."""
+
+    def __init__(
+        self,
+        name: str,
+        session: ServeSession,
+        health_url: Optional[str] = None,
+        health_fn: Optional[Callable[[], dict]] = None,
+        idle_sleep_s: float = 0.0005,
+        scrape_timeout_s: float = 1.0,
+    ):
+        self.name = str(name)
+        self.session = session
+        self.health_url = health_url
+        self.health_fn = health_fn
+        self.idle_sleep_s = idle_sleep_s
+        self.scrape_timeout_s = scrape_timeout_s
+        self._inbox: deque = deque()
+        self._results: Dict[Any, Result] = {}
+        self._results_lock = threading.Lock()
+        self._published: dict = {"healthy": True, **session.engine.health()}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.failed = False  # a test/chaos hook: failed => loop exits
+
+    # -- router-facing surface (thread-safe) ---------------------------
+
+    def submit(
+        self, request: Request, deadline_at: Optional[float] = None
+    ) -> None:
+        """Queue a request for the replica thread. ``deadline_at`` is
+        the ABSOLUTE deadline stamped at the router door — the replica
+        evaluates the remaining budget when it pops the inbox, so time
+        spent queued here counts against the client's deadline instead
+        of restarting it."""
+        self._inbox.append((request, deadline_at))
+
+    def seat_prefilled(self, item) -> None:
+        """Queue an externally prefilled request (engine._Prefilled)
+        straight onto the engine's disaggregation inbox."""
+        self.session.engine.prefill_inbox.append(item)
+
+    def take(self) -> Dict[Any, Result]:
+        """Hand over every Result harvested since the last take()."""
+        with self._results_lock:
+            out = self._results
+            self._results = {}
+        return out
+
+    def scrape(self) -> dict:
+        """The router's view of this replica's health: the published
+        engine snapshot, or — when ``health_url`` is set — a real HTTP
+        GET of a ``/healthz`` endpoint (non-200, unreachable, or
+        ``healthy: false`` all read as unready). ``health_fn`` overrides
+        both (test seam / custom probes)."""
+        if self.failed:
+            return {"healthy": False, "error": "replica failed"}
+        if self.health_fn is not None:
+            try:
+                return dict(self.health_fn())
+            except Exception as e:
+                return {"healthy": False, "error": f"{type(e).__name__}: {e}"}
+        if self.health_url is not None:
+            try:
+                with urllib.request.urlopen(
+                    self.health_url, timeout=self.scrape_timeout_s
+                ) as resp:
+                    payload = json.loads(resp.read().decode())
+            except urllib.error.HTTPError as e:
+                # 503 carries the health JSON in its body; surface it.
+                try:
+                    payload = json.loads(e.read().decode())
+                except Exception:
+                    payload = {}
+                payload["healthy"] = False
+                payload.setdefault("error", f"HTTP {e.code}")
+                return payload
+            except Exception as e:
+                return {"healthy": False, "error": f"{type(e).__name__}: {e}"}
+            # A full /healthz document: the engine's state lives under
+            # sources.serve_engine; overall healthy gates readiness.
+            engine = payload.get("sources", {}).get("serve_engine", {})
+            out = {**self._published, **engine}
+            out["healthy"] = bool(payload.get("healthy", True))
+            return out
+        return dict(self._published)
+
+    @property
+    def load(self) -> float:
+        """Normalized busyness from the last scrape/publish — the
+        least-loaded placement key."""
+        h = self._published
+        cap = max(
+            1, h.get("num_slots", 1) + h.get("queue_capacity", 0)
+        )
+        return (h.get("slots_busy", 0) + h.get("queue_depth", 0)) / cap
+
+    # -- the replica thread --------------------------------------------
+
+    def start(self) -> "Replica":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name=f"tpudl-replica-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, join_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_s)
+            self._thread = None
+
+    def _loop(self) -> None:
+        session = self.session
+        engine = session.engine
+        error = "replica stopped"
+        try:
+            while not self._stop.is_set() and not self.failed:
+                worked = False
+                while self._inbox:
+                    request, deadline_at = self._inbox.popleft()
+                    if deadline_at is not None:
+                        remaining = deadline_at - time.monotonic()
+                        if remaining <= 0:
+                            # Deadline expired while queued in THIS
+                            # inbox: shed, never start (AdmissionQueue's
+                            # guarantee, kept across the router hop).
+                            wait = 0.0
+                            if request.deadline_s is not None:
+                                wait = max(
+                                    0.0,
+                                    time.monotonic()
+                                    - (deadline_at - request.deadline_s),
+                                )
+                            with self._results_lock:
+                                self._results[request.request_id] = Result(
+                                    request_id=request.request_id,
+                                    tokens=[],
+                                    finish_reason="shed_timeout",
+                                    queue_wait_s=wait,
+                                )
+                            registry().counter(
+                                "serve_requests_shed_timeout"
+                            ).inc()
+                            worked = True
+                            continue
+                        # Hand the engine only the REMAINING budget —
+                        # session.submit would otherwise restart the
+                        # full deadline_s from its own clock.
+                        request = dataclasses.replace(
+                            request, deadline_s=remaining
+                        )
+                    try:
+                        session.submit(request)
+                    except ValueError as e:
+                        # Unservable at this session's compiled shapes
+                        # (or a duplicate) — surface a Result instead
+                        # of swallowing it, or the router would wait
+                        # forever.
+                        with self._results_lock:
+                            self._results[request.request_id] = Result(
+                                request_id=request.request_id, tokens=[],
+                                finish_reason=f"rejected: {e}",
+                            )
+                    worked = True
+                if engine.step():
+                    worked = True
+                # Drain engine.results directly (NOT via _pending_ids):
+                # disaggregated requests arrive through the prefill
+                # inbox without a session.submit, but their Results
+                # land in the same dict.
+                harvested = {}
+                for rid in list(engine.results):
+                    harvested[rid] = engine.results.pop(rid)
+                    session._pending_ids.discard(rid)
+                if harvested:
+                    with self._results_lock:
+                        self._results.update(harvested)
+                    worked = True
+                self._published = engine.health()
+                if not worked:
+                    time.sleep(self.idle_sleep_s)
+        except BaseException as e:
+            error = f"replica crashed: {type(e).__name__}: {e}"
+            raise
+        finally:
+            # A dead thread drains nothing: ALWAYS publish unhealthy —
+            # clean stop() AND crash alike — so a router still scraping
+            # this replica stops routing to it and fails its
+            # outstanding work over. Before this ran in straight-line
+            # code, an engine.step() exception left the last HEALTHY
+            # snapshot published forever while submissions rotted.
+            try:
+                base = engine.health()
+            except Exception:
+                base = {}
+            self._published = {**base, "healthy": False, "error": error}
+
+
+class PrefillWorker:
+    """A dedicated prefill replica: runs ONLY the batch-1 prefill
+    program, turning popped requests into ``(row cache, first token)``
+    handoffs for decode replicas — the prefill half of prefill/decode
+    disaggregation. ``place`` (set by the Router) picks the decode
+    replica at completion time, so placement uses post-prefill load."""
+
+    def __init__(
+        self,
+        name: str,
+        prefill_call: Callable,
+        params: Any,
+        prompt_len: int,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = str(name)
+        self.prefill_call = prefill_call
+        self.params = params
+        self.prompt_len = prompt_len
+        self.clock = clock
+        self.place: Optional[Callable[[Any], None]] = None
+        #: Set by the Router: called with an _Entry whose deadline
+        #: passed before prefill started (the disaggregated analog of
+        #: AdmissionQueue's pop-time shedding).
+        self.shed: Optional[Callable[[Any], None]] = None
+        #: Set by the Router: called with (entry, exception) when a
+        #: request blows up mid-prefill — the worker thread must
+        #: survive (its inbox feeds every later disaggregated request),
+        #: so the failure surfaces as a Result instead of killing it.
+        self.fail: Optional[Callable[[Any, BaseException], None]] = None
+        self._inbox: deque = deque()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.num_prefills = 0
+
+    @classmethod
+    def from_model(
+        cls, name: str, model, params, prompt_len: int, **kwargs
+    ) -> "PrefillWorker":
+        import jax
+
+        from tpudl.models.generate import prefill_fn
+
+        return cls(
+            name, jax.jit(prefill_fn(model)), params, prompt_len, **kwargs
+        )
+
+    def submit(self, entry: _Entry) -> None:
+        self._inbox.append(entry)
+
+    def __len__(self) -> int:
+        return len(self._inbox)
+
+    def start(self) -> "PrefillWorker":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name=f"tpudl-prefill-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, join_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=join_s)
+            self._thread = None
+
+    def _loop(self) -> None:
+        import numpy as np
+
+        from tpudl.serve.engine import (
+            CAT_SERVE_PREFILL,
+            _Prefilled,
+            first_token,
+        )
+
+        while not self._stop.is_set():
+            if not self._inbox:
+                time.sleep(0.0005)
+                continue
+            entry = self._inbox.popleft()
+            if (
+                entry.deadline is not None
+                and self.clock() > entry.deadline
+            ):
+                # Never START a request past its deadline — the same
+                # guarantee AdmissionQueue's pop-time shedding gives
+                # the non-disaggregated path.
+                if self.shed is not None:
+                    self.shed(entry)
+                continue
+            try:
+                req = entry.request
+                ids = np.asarray(req.input_ids, np.int32)
+                pad = self.prompt_len - ids.shape[0]
+                padded = np.concatenate(
+                    [np.zeros(pad, np.int32), ids]
+                )[None, :]
+                mask = np.concatenate(
+                    [np.zeros(pad, np.int32),
+                     np.ones(ids.shape[0], np.int32)]
+                )[None, :]
+                t0 = self.clock()
+                logits, row_cache = self.prefill_call(
+                    self.params, padded, mask
+                )
+                first = first_token(logits, req)
+                now = self.clock()
+                rec = active_recorder()
+                if rec is not None:
+                    rec.record(
+                        "prefill", CAT_SERVE_PREFILL, t0, now - t0,
+                        {"worker": self.name,
+                         "request_id": req.request_id,
+                         "queue_wait_s": t0 - entry.submitted_at,
+                         "disaggregated": True},
+                    )
+                self.num_prefills += 1
+                registry().counter("serve_prefills").inc()
+                registry().counter("serve_disaggregated_prefills").inc()
+                item = _Prefilled(
+                    entry, row_cache, first, int(ids.shape[0]), t0, now
+                )
+                if self.place is None:
+                    raise RuntimeError(
+                        "PrefillWorker has no placement hook — attach "
+                        "it to a Router (prefill=[...]) before "
+                        "submitting work"
+                    )
+                self.place(item)
+            except Exception as e:
+                # One poisoned request must not kill the worker thread
+                # and strand every later inbox entry; without a router
+                # hook (standalone use) the failure still propagates.
+                if self.fail is None:
+                    raise
+                self.fail(entry, e)
+
+
+class Router:
+    """Load-balancing front over N serving replicas.
+
+    ``submit()`` places a request (sticky, then least-loaded among
+    ready replicas — or onto the prefill tier when disaggregating),
+    ``collect()`` blocks until every outstanding request has a Result
+    (driving scrape/failover on the way), ``poll()`` is the
+    non-blocking harvest for open-loop drivers. Results are keyed by
+    request_id exactly like ServeSession's.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Replica],
+        prefill: Sequence[PrefillWorker] = (),
+        scrape_interval_s: float = 0.02,
+        shed_priority_above: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not replicas:
+            raise ValueError("Router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique, got {names}")
+        self.replicas: List[Replica] = list(replicas)
+        self.prefill_workers: List[PrefillWorker] = list(prefill)
+        # Replicas share compiled shapes (they are built from the same
+        # programs); admission-validate at the router door so an
+        # unservable request is a caller-visible ValueError instead of
+        # a prefill-worker crash or a forever-blocked engine inbox.
+        session0 = self.replicas[0].session
+        self._prompt_len = session0.prompt_len
+        self._max_seq_len = session0.max_seq_len
+        self.scrape_interval_s = scrape_interval_s
+        self.shed_priority_above = shed_priority_above
+        self.clock = clock
+        self.results: Dict[Any, Result] = {}
+        self._assigned: Dict[Any, Any] = {}  # rid -> (replica_name|None, Request)
+        self._sticky: Dict[Any, str] = {}  # session_key -> replica name
+        # rid -> ABSOLUTE deadline, stamped once at first submit: the
+        # client's budget spans every hop (router -> replica inbox ->
+        # engine queue) and survives failover — a resubmission must not
+        # restart it.
+        self._deadline_at: Dict[Any, float] = {}
+        # Router-side in-flight TOKEN budget per replica (sum of
+        # outstanding max_new_tokens): the placement signal BETWEEN
+        # scrapes. A burst submitted faster than replicas publish
+        # health would otherwise all land on one replica (every scraped
+        # load still reads 0), and counting REQUESTS instead of tokens
+        # piles every long request onto one replica on a ragged mix.
+        self._inflight: Dict[str, int] = {r.name: 0 for r in replicas}
+        # Guards the routing books — _inflight, _assigned, _sticky,
+        # and results: all four are mutated from the router's caller
+        # thread AND the prefill workers' placement/shed hooks — an
+        # unguarded dict mutation can crash a concurrent _failover
+        # iteration, and a lost in-flight update skews placement
+        # forever. Reentrant because _failover resubmits through
+        # submit() and placement sheds through _shed().
+        self._books = threading.RLock()
+        self._ready: Dict[str, bool] = {r.name: True for r in replicas}
+        self._burning: Dict[str, frozenset] = {}
+        self._last_scrape = float("-inf")
+        self._seq = 0
+        self.num_failovers = 0
+        for worker in self.prefill_workers:
+            worker.place = self._place_prefilled
+            worker.shed = self._shed_prefill_entry
+            worker.fail = self._fail_prefill_entry
+            worker.start()
+        for replica in self.replicas:
+            replica.start()
+            slo = replica.session.engine._slo
+            if slo is not None:
+                self._subscribe_slo(replica.name, slo)
+        self._register_health_source()
+        self._scrape(force=True)
+
+    # -- SLO / health wiring -------------------------------------------
+
+    def _subscribe_slo(self, name: str, monitor) -> None:
+        self._burning[name] = frozenset()
+
+        def _on_transition(objective, state):
+            prev = self._burning.get(name, frozenset())
+            if state["burning"]:
+                self._burning[name] = prev | {objective.name}
+            else:
+                self._burning[name] = prev - {objective.name}
+            registry().gauge("serve_router_burning_replicas").set(
+                sum(1 for b in self._burning.values() if b)
+            )
+
+        monitor.subscribe(_on_transition)
+
+    @property
+    def burning(self) -> bool:
+        """True while ANY replica's SLO monitor has a burning
+        objective — the router's per-class shed condition."""
+        return any(self._burning.values())
+
+    def _register_health_source(self) -> None:
+        import weakref
+
+        from tpudl.obs import exporter as obs_exporter
+
+        self_ref = weakref.ref(self)
+
+        def _router_health() -> dict:
+            router = self_ref()
+            if router is None:
+                return {"healthy": True, "router": "collected"}
+            ready = sum(1 for v in router._ready.values() if v)
+            return {
+                "healthy": ready > 0,
+                "ready_replicas": ready,
+                "total_replicas": len(router.replicas),
+                "burning_replicas": sorted(
+                    n for n, b in router._burning.items() if b
+                ),
+                "outstanding": len(router._assigned),
+                "autoscale_hint": router._autoscale_hint(),
+            }
+
+        obs_exporter.register_health_source("serve_router", _router_health)
+
+    def _autoscale_hint(self) -> int:
+        """Replicas' worth of missing capacity: burning replicas are
+        overloaded (each wants one more), unready ones are gone (each
+        wants a replacement). 0 = fleet is sized right."""
+        burning = sum(1 for b in self._burning.values() if b)
+        unready = sum(1 for v in self._ready.values() if not v)
+        return burning + unready
+
+    # -- scraping / failover -------------------------------------------
+
+    def _scrape(self, force: bool = False) -> None:
+        """Refresh every replica's readiness from its scraped health
+        (time-gated by ``scrape_interval_s``); requeue the outstanding
+        work of replicas that went unready."""
+        now = self.clock()
+        if not force and now - self._last_scrape < self.scrape_interval_s:
+            return
+        self._last_scrape = now
+        reg = registry()
+        newly_down: List[str] = []
+        for replica in self.replicas:
+            h = replica.scrape()
+            ready = bool(h.get("healthy", True))
+            if self._ready.get(replica.name) and not ready:
+                newly_down.append(replica.name)
+            self._ready[replica.name] = ready
+            suffix = _metric_suffix(replica.name)
+            reg.gauge(f"serve_replica_{suffix}_ready").set(int(ready))
+            reg.gauge(f"serve_replica_{suffix}_slots_busy").set(
+                h.get("slots_busy", 0)
+            )
+            reg.gauge(f"serve_replica_{suffix}_queue_depth").set(
+                h.get("queue_depth", 0)
+            )
+        reg.gauge("serve_router_ready_replicas").set(
+            sum(1 for v in self._ready.values() if v)
+        )
+        reg.gauge("serve_router_autoscale_hint").set(self._autoscale_hint())
+        for name in newly_down:
+            self._failover(name)
+
+    def _failover(self, name: str) -> None:
+        """Resubmit every outstanding request assigned to ``name``:
+        its results to date are harvested first (completed work is
+        kept), the rest restart on surviving replicas. Sticky keys
+        pinned to the dead replica are released."""
+        replica = next(r for r in self.replicas if r.name == name)
+        self._harvest_one(replica)
+        with self._books:
+            doomed = [
+                (rid, req)
+                for rid, (owner, req) in self._assigned.items()
+                if owner == name
+            ]
+            self._sticky = {
+                k: v for k, v in self._sticky.items() if v != name
+            }
+            # Assignments are cleared BEFORE resubmission, so a late
+            # Result from the failed replica can't race the restarted
+            # one (harvest accepts a Result only from the current
+            # assignee).
+            for rid, req in doomed:
+                del self._assigned[rid]
+                self._inflight[name] -= req.max_new_tokens
+        reg = registry()
+        for rid, req in doomed:
+            self.num_failovers += 1
+            reg.counter("serve_router_requests_failed_over").inc()
+            rec = active_recorder()
+            if rec is not None:
+                rec.event(
+                    "request_failover", CAT_SERVE_REQUEST,
+                    request_id=rid, from_replica=name,
+                )
+            self.submit(req)
+
+    def _harvest_one(self, replica: Replica) -> None:
+        taken = replica.take()
+        if not taken:
+            return
+        with self._books:
+            for rid, res in taken.items():
+                owner, _ = self._assigned.get(rid, (None, None))
+                if owner == replica.name:
+                    _, req = self._assigned.pop(rid)
+                    self._inflight[owner] -= req.max_new_tokens
+                    self._deadline_at.pop(rid, None)
+                    self.results[rid] = res
+                # else: a late result from a failed-over assignment —
+                # the restarted copy is authoritative; drop this one.
+
+    def _harvest(self) -> None:
+        for replica in self.replicas:
+            self._harvest_one(replica)
+
+    # -- placement ------------------------------------------------------
+
+    def _ready_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if self._ready.get(r.name)]
+
+    def _least_loaded(self) -> Optional[Replica]:
+        ready = self._ready_replicas()
+        if not ready:
+            return None
+        # In-flight books lead (request-count accurate the instant a
+        # placement happens); the scraped load refines between equal
+        # counts (a replica deep in long generations scrapes busier).
+        return min(
+            ready, key=lambda r: (self._inflight[r.name], r.load)
+        )
+
+    def _shed(
+        self, request: Request, reason: str, queue_wait_s: float = 0.0
+    ) -> None:
+        with self._books:
+            self._deadline_at.pop(request.request_id, None)
+            self.results[request.request_id] = Result(
+                request_id=request.request_id, tokens=[],
+                finish_reason=reason, queue_wait_s=queue_wait_s,
+            )
+        registry().counter(f"serve_requests_{reason}").inc()
+        rec = active_recorder()
+        if rec is not None:
+            rec.event(
+                "request_complete", CAT_SERVE_REQUEST,
+                request_id=request.request_id, finish_reason=reason,
+                queue_wait_s=queue_wait_s, num_tokens=0, shed_by="router",
+            )
+
+    def _shed_prefill_entry(self, entry) -> None:
+        """PrefillWorker deadline hook (worker thread): the
+        disaggregated analog of AdmissionQueue's pop-time shedding —
+        release the assignment and record a ``shed_timeout`` Result
+        with the real queue wait, mirroring the engine's shape."""
+        request = entry.request
+        with self._books:
+            self._assigned.pop(request.request_id, None)
+        self._shed(
+            request, "shed_timeout",
+            queue_wait_s=self.clock() - entry.submitted_at,
+        )
+
+    def _fail_prefill_entry(self, entry, exc: BaseException) -> None:
+        """PrefillWorker exception hook (worker thread): a request
+        that blew up mid-prefill surfaces as a Result — releasing its
+        assignment so collect() doesn't wait forever — and the worker
+        thread survives for the rest of its inbox."""
+        request = entry.request
+        with self._books:
+            self._assigned.pop(request.request_id, None)
+            self._deadline_at.pop(request.request_id, None)
+            self.results[request.request_id] = Result(
+                request_id=request.request_id, tokens=[],
+                finish_reason=f"failed: {type(exc).__name__}: {exc}",
+                queue_wait_s=self.clock() - entry.submitted_at,
+            )
+        registry().counter("serve_requests_failed").inc()
+        rec = active_recorder()
+        if rec is not None:
+            rec.event(
+                "request_complete", CAT_SERVE_REQUEST,
+                request_id=request.request_id, finish_reason="failed",
+                error=f"{type(exc).__name__}: {exc}",
+                num_tokens=0, shed_by="router",
+            )
+
+    def submit(self, request: Request) -> Any:
+        """Place one request. Sticky key first, else least-loaded ready
+        replica (or the prefill tier when disaggregating). While any
+        replica's SLO burns, best-effort requests
+        (priority > shed_priority_above) shed at the door."""
+        rid = request.request_id
+        validate_request(request, self._prompt_len, self._max_seq_len)
+        self._scrape()
+        with self._books:
+            if rid in self._assigned or rid in self.results:
+                raise ValueError(f"duplicate request_id {rid!r}")
+            if (
+                self.burning
+                and request.priority > self.shed_priority_above
+            ):
+                self._shed(request, "shed_slo")
+                return rid
+            target = self._pick(request)
+            if target is None:
+                # No ready replica at all: overload/outage is data, not
+                # an exception (same contract as a full admission
+                # queue).
+                self._shed(request, "shed_capacity")
+                return rid
+            now = self.clock()
+            deadline_at = self._deadline_at.get(rid)
+            if deadline_at is None and request.deadline_s is not None:
+                # Stamped ONCE: a failover resubmission finds the
+                # original stamp and keeps the client's real budget
+                # instead of granting a fresh full one.
+                deadline_at = now + request.deadline_s
+                self._deadline_at[rid] = deadline_at
+            if self.prefill_workers:
+                # Disaggregated path: the request becomes a queue entry
+                # on the least-busy prefill worker; the decode replica
+                # (and any sticky pin) is chosen at prefill completion,
+                # when post-prefill load is known. The assignment owner
+                # is resolved then, so track it as in-flight (owner
+                # None).
+                self._assigned[rid] = (None, request)
+                worker = min(self.prefill_workers, key=len)
+                self._seq += 1
+                worker.submit(_Entry(
+                    priority=request.priority, seq=self._seq,
+                    request=request,
+                    deadline=deadline_at,
+                    submitted_at=now,
+                ))
+            else:
+                if request.session_key is not None:
+                    self._sticky[request.session_key] = target.name
+                self._assigned[rid] = (target.name, request)
+                self._inflight[target.name] += request.max_new_tokens
+                target.submit(request, deadline_at)
+        registry().counter("serve_router_requests_routed").inc()
+        return rid
+
+    def _pick(self, request: Request) -> Optional[Replica]:
+        """Sticky pin first (if its replica is still ready), else the
+        least-loaded ready replica. Callers hold ``_books``."""
+        if request.session_key is not None:
+            pinned = self._sticky.get(request.session_key)
+            if pinned is not None and self._ready.get(pinned):
+                return next(
+                    r for r in self.replicas if r.name == pinned
+                )
+        return self._least_loaded()
+
+    def _place_prefilled(self, item) -> None:
+        """PrefillWorker completion hook (worker thread): hand the
+        prefilled request to its sticky replica, else the least-loaded
+        ready decode replica's engine inbox — the same placement
+        contract submit() gives the non-disaggregated path."""
+        request = item.entry.request
+        rid = request.request_id
+        with self._books:
+            if rid not in self._assigned:
+                # Assignment already resolved elsewhere (shed/cancel):
+                # placing it would decode a request the caller was
+                # already handed a Result for.
+                return
+            target = self._pick(request)
+            if target is None:
+                # Nothing ready to decode: shed rather than park the
+                # work on a dead replica — failover only fires on a
+                # ready->unready EDGE, so a request placed on an
+                # already-unready replica would strand forever.
+                self._assigned.pop(rid, None)
+                self._shed(
+                    request, "shed_capacity",
+                    queue_wait_s=self.clock() - item.entry.submitted_at,
+                )
+                return
+            if request.session_key is not None:
+                self._sticky[request.session_key] = target.name
+            self._assigned[rid] = (target.name, request)
+            self._inflight[target.name] += request.max_new_tokens
+        target.seat_prefilled(item)
+
+    # -- the request lifecycle ------------------------------------------
+
+    def poll(self) -> Dict[Any, Result]:
+        """Non-blocking: scrape (failover if needed), harvest, and hand
+        over every Result completed so far."""
+        self._scrape()
+        self._harvest()
+        with self._books:
+            out = self.results
+            self.results = {}
+        return out
+
+    def collect(self, timeout_s: Optional[float] = None) -> Dict[Any, Result]:
+        """Block until every outstanding request has a Result (scraping
+        and failing over on the way)."""
+        deadline = (
+            None if timeout_s is None else self.clock() + timeout_s
+        )
+        out: Dict[Any, Result] = {}
+        while True:
+            out.update(self.poll())
+            if not self._assigned:
+                return out
+            if deadline is not None and self.clock() > deadline:
+                raise TimeoutError(
+                    f"router collect(): {len(self._assigned)} requests "
+                    f"still outstanding after {timeout_s}s "
+                    f"(ready replicas: {sorted(n for n, v in self._ready.items() if v)})"
+                )
+            time.sleep(0.001)
+
+    def serve(
+        self, requests: Sequence[Request], timeout_s: Optional[float] = None
+    ) -> Dict[Any, Result]:
+        for request in requests:
+            self.submit(request)
+        return self.collect(timeout_s=timeout_s)
+
+    def close(self) -> None:
+        for worker in self.prefill_workers:
+            worker.stop()
+        for replica in self.replicas:
+            replica.stop()
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
