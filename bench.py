@@ -450,6 +450,32 @@ def _bench_serve_replicas():
     return measure_serve_replicas()
 
 
+def _bench_parity_grid():
+    """Low-precision serving grid (benchmarks/parity_grid.py): every
+    precision x backend cell parity-gated against the f32 reference,
+    reporting the int8-weights simulated-device TPOT, the quantized
+    weight-bytes ratio, and the number of cells that passed. Banked by
+    scripts/bench_regress.py from r06 onward."""
+    from benchmarks.parity_grid import measure_parity_grid
+
+    return measure_parity_grid()
+
+
+def _bench_block_pins():
+    """ROADMAP item-1 follow-through: run the fused-epilogue
+    block-size sweep and record the winning env pins in the JSON tail,
+    so a TPU round's evidence for flipping fused defaults is banked
+    next to the metrics it would move. Off-TPU the sweep runs the tiny
+    smoke shapes (interpret-mode Pallas) — plumbing-checkable, but the
+    pins that matter come from the driver's TPU rounds."""
+    from benchmarks.fused_epilogue import block_pins, sweep_args, sweep_blocks
+    from tpudl.ops.attention import is_tpu_backend
+
+    best = sweep_blocks(sweep_args(smoke=not is_tpu_backend()), measure=5)
+    pins, command = block_pins(best)
+    return {"per_family": best, "pins": pins, "command": command}
+
+
 def _bench_ft():
     """Fault-tolerance costs (benchmarks/ft_recovery.py): the async
     checkpoint's on-step stall and the kill-to-first-post-restart-step
@@ -564,6 +590,24 @@ def main(argv=None):
         print("fault-tolerance bench failed:", file=sys.stderr)
         traceback.print_exc()
         ft = {}
+    try:
+        parity_grid = _bench_parity_grid()
+    except Exception:
+        import sys
+        import traceback
+
+        print("parity-grid bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        parity_grid = {}
+    try:
+        block_pins = _bench_block_pins()
+    except Exception:
+        import sys
+        import traceback
+
+        print("block-pin sweep failed:", file=sys.stderr)
+        traceback.print_exc()
+        block_pins = {}
 
     vs_baseline = (
         bert_sps / BASELINE_BERT_SAMPLES_PER_SEC
@@ -681,6 +725,26 @@ def main(argv=None):
         "recovery_time_sec": round(ft["recovery_time_sec"], 3)
         if "recovery_time_sec" in ft
         else None,
+        # Low-precision serving grid (tpudl.quant via benchmarks/
+        # parity_grid.py): simulated-device TPOT of the int8-weights
+        # cell, the stored-bytes ratio on its quantized layers
+        # (>= 3.5x asserted in the benchmark), and how many
+        # precision x backend cells passed their parity gate.
+        "serve_tpot_int8_weights_ms": parity_grid.get(
+            "serve_tpot_int8_weights_ms"
+        ),
+        "quant_weight_bytes_ratio": parity_grid.get(
+            "quant_weight_bytes_ratio"
+        ),
+        "parity_grid_cells_passed": parity_grid.get(
+            "parity_grid_cells_passed"
+        ),
+        # JSON tail: the fused-epilogue block-size sweep's winning
+        # pins (benchmarks/fused_epilogue.py --sweep-blocks) — the
+        # evidence a TPU round uses to flip fused defaults. Non-numeric
+        # on purpose; the regression gate skips them.
+        "fused_block_pins": block_pins.get("pins"),
+        "fused_block_pin_cmd": block_pins.get("command"),
     }
     print(json.dumps(result))
     return _regression_gate(result, strict=args.strict)

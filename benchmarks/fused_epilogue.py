@@ -176,6 +176,49 @@ def sweep_blocks(args, measure: int = 8):
     return best
 
 
+def sweep_args(smoke: bool = False, **overrides) -> argparse.Namespace:
+    """A ``sweep_blocks``-ready namespace without going through the
+    CLI — bench.py's entry for recording the block pins each round."""
+    ns = argparse.Namespace(
+        rows=None, hidden=768, intermediate=3072, vocab=30_522,
+        ce_rows=None, dtype="bfloat16", smoke=smoke,
+    )
+    for key, value in overrides.items():
+        setattr(ns, key, value)
+    return ns
+
+
+def block_pins(best: dict) -> tuple:
+    """Reduce a ``sweep_blocks`` result to the two env pins: the four
+    row-block families share TPUDL_NORM_BLOCK_ROWS, so the pin is the
+    MAJORITY winner among them (ties break toward the
+    layer_norm+residual family — the BERT headline's hottest epilogue
+    — then toward the smaller block); cross-entropy owns
+    TPUDL_CE_VOCAB_BLOCK alone. Returns ``(pins, command)`` where
+    ``command`` is the env prefix a TPU run pastes to flip fused
+    defaults with evidence (the ROADMAP item-1 follow-through bench.py
+    records in its JSON tail)."""
+    from collections import Counter
+
+    pins = {}
+    row_best = {
+        name: block for name, block in best.items()
+        if name != "cross_entropy"
+    }
+    if row_best:
+        counts = Counter(row_best.values())
+        top = max(counts.values())
+        candidates = sorted(b for b, c in counts.items() if c == top)
+        anchor = row_best.get("layer_norm+residual")
+        pins["TPUDL_NORM_BLOCK_ROWS"] = (
+            anchor if anchor in candidates else candidates[0]
+        )
+    if "cross_entropy" in best:
+        pins["TPUDL_CE_VOCAB_BLOCK"] = best["cross_entropy"]
+    command = " ".join(f"{k}={v}" for k, v in sorted(pins.items()))
+    return pins, command
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--rows", type=int, default=None,
@@ -197,7 +240,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.sweep_blocks:
-        sweep_blocks(args)
+        best = sweep_blocks(args)
+        pins, command = block_pins(best)
+        if command:
+            print(f"pin the winners: {command}", flush=True)
         return
 
     from tpudl.ops.cross_entropy import (

@@ -223,13 +223,14 @@ def _latency_stats(results: Dict) -> dict:
     tpots = np.asarray([r.tpot_s for r in ok if r.tpot_s is not None])
 
     def pct(xs):
+        # One percentile definition across every benchmark
+        # (tpudl.export.latency.LatencyStats — parity_grid and the
+        # latency harness consume the same summary).
+        from tpudl.export.latency import LatencyStats
+
         if xs.size == 0:
             return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
-        return {
-            "p50_ms": round(1e3 * float(np.percentile(xs, 50)), 3),
-            "p95_ms": round(1e3 * float(np.percentile(xs, 95)), 3),
-            "p99_ms": round(1e3 * float(np.percentile(xs, 99)), 3),
-        }
+        return LatencyStats.from_seconds(xs).percentiles()
 
     return {
         "completed": len(ok),

@@ -69,6 +69,16 @@ NOISE_BAND_FLOORS = {
     # jitter moves the routed throughput more than the engine's.
     "serve_tokens_per_sec_2rep": 0.25,
     "serve_scaling_efficiency": 0.15,
+    # Deterministic byte accounting (cache layout arithmetic, not a
+    # timing draw): any drift beyond rounding is a real layout change.
+    "serve_kv_slots_per_gb": 0.05,
+    # Parity-grid keys (benchmarks/parity_grid.py, banked from r06).
+    # TPOT rides the simulated-device sleep + host dispatch on 1 vCPU;
+    # the bytes ratio is arithmetic; cells_passed only moves when a
+    # cell is added or breaks — a drop of even one cell must gate.
+    "serve_tpot_int8_weights_ms": 0.50,
+    "quant_weight_bytes_ratio": 0.05,
+    "parity_grid_cells_passed": 0.01,
     "input_pipeline_images_per_sec_host": 0.20,
     "checkpoint_step_stall_ms": 0.50,
     "checkpoint_sync_save_ms": 0.50,
@@ -81,6 +91,7 @@ DEFAULT_BAND_FLOOR = 0.08
 #: other numeric metric is treated as higher-is-better throughput/MFU.
 LOWER_IS_BETTER = {
     "serve_p99_ttft_ms",
+    "serve_tpot_int8_weights_ms",
     "checkpoint_step_stall_ms",
     "checkpoint_sync_save_ms",
     "recovery_time_sec",

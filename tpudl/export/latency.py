@@ -14,11 +14,76 @@ single sample, `latency` mutated as a closure global):
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Callable, Optional, Sequence
 
 import jax
 import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencyStats:
+    """Percentile summary of one timing series (milliseconds) — the ONE
+    definition of "p50/p95/p99/max" every benchmark consumes
+    (``latency_benchmark`` below, ``benchmarks/serve_load.py``,
+    ``benchmarks/parity_grid.py``) instead of each hand-rolling its own
+    np.percentile calls. Only post-warmup samples should ever enter:
+    serving SLOs are quoted at tail percentiles, and a mean/min pair
+    hides exactly the outliers that matter."""
+
+    count: int
+    mean_ms: float
+    p50_ms: float
+    p95_ms: float
+    p99_ms: float
+    min_ms: float
+    max_ms: float
+
+    @classmethod
+    def from_ms(cls, samples_ms: Sequence[float]) -> "LatencyStats":
+        xs = np.asarray(samples_ms, dtype=np.float64)
+        if xs.size == 0:
+            raise ValueError(
+                "LatencyStats needs at least one sample (callers decide "
+                "how to render an empty series)"
+            )
+        return cls(
+            count=int(xs.size),
+            mean_ms=float(xs.mean()),
+            p50_ms=float(np.percentile(xs, 50)),
+            p95_ms=float(np.percentile(xs, 95)),
+            p99_ms=float(np.percentile(xs, 99)),
+            min_ms=float(xs.min()),
+            max_ms=float(xs.max()),
+        )
+
+    @classmethod
+    def from_seconds(cls, samples_s: Sequence[float]) -> "LatencyStats":
+        return cls.from_ms(np.asarray(samples_s, dtype=np.float64) * 1e3)
+
+    def as_dict(self) -> dict:
+        """The legacy ``latency_benchmark`` stats schema (mean/p50/p95/
+        p99/min/max, no count — existing consumers key on exactly
+        these)."""
+        return {
+            "mean_ms": self.mean_ms,
+            "p50_ms": self.p50_ms,
+            "p95_ms": self.p95_ms,
+            "p99_ms": self.p99_ms,
+            "min_ms": self.min_ms,
+            "max_ms": self.max_ms,
+        }
+
+    def percentiles(self, digits: int = 3) -> dict:
+        """The serving-benchmark tail summary ({p50,p95,p99}_ms,
+        rounded) — benchmarks/serve_load.py's per-request TTFT/TPOT
+        rendering."""
+        return {
+            "p50_ms": round(self.p50_ms, digits),
+            "p95_ms": round(self.p95_ms, digits),
+            "p99_ms": round(self.p99_ms, digits),
+        }
 
 
 def _sync(out) -> float:
@@ -78,26 +143,13 @@ def latency_benchmark(
         _sync(out)
         compute_ms.append((time.perf_counter() - t0) * 1e3)
 
-    def stats(xs):
-        # Tail percentiles alongside the legacy keys: serving SLOs are
-        # quoted at p99, and a mean/min pair hides exactly the outliers
-        # that matter. Only post-warmup iterations ever enter `xs` (the
-        # warmup loops above run outside the timed windows), so these
-        # are steady-state statistics.
-        xs = np.asarray(xs)
-        return {
-            "mean_ms": float(xs.mean()),
-            "p50_ms": float(np.percentile(xs, 50)),
-            "p95_ms": float(np.percentile(xs, 95)),
-            "p99_ms": float(np.percentile(xs, 99)),
-            "min_ms": float(xs.min()),
-            "max_ms": float(xs.max()),
-        }
-
+    # Only post-warmup iterations ever enter the series (the warmup
+    # loops above run outside the timed windows), so these are
+    # steady-state statistics.
     return {
         "device": str(device),
         "iters": iters,
         "warmup": warmup,
-        "transfer": stats(transfer_ms),
-        "compute": stats(compute_ms),
+        "transfer": LatencyStats.from_ms(transfer_ms).as_dict(),
+        "compute": LatencyStats.from_ms(compute_ms).as_dict(),
     }

@@ -74,6 +74,15 @@ class BertConfig:
     #: off-TPU — the CPU parity-test mode). Param tree is identical in
     #: all modes, so checkpoints and HF imports are interchangeable.
     fused_ops: Any = False
+    #: Low-precision weight tier (tpudl.quant): None (default) = plain
+    #: nn.Dense, bit-identical to before the tier; "int8"/"fp8_e4m3" =
+    #: encoder attention + MLP projections become QuantDense (serves
+    #: the quantize_tree output with dequant fused into the
+    #: contraction; full-precision kernels run the exact nn.Dense
+    #: math). Embeddings, LayerNorms, pooler, and the classifier head
+    #: always stay full precision. Param-tree structure is identical
+    #: in all modes.
+    weight_dtype: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -87,7 +96,21 @@ BERT_LARGE = partial(BertConfig, hidden_size=1024, num_layers=24, num_heads=16,
                      intermediate_size=4096)
 
 
-def _dense(cfg: BertConfig, features: int, name: str) -> nn.Dense:
+def _dense(cfg: BertConfig, features: int, name: str, quantize: bool = False):
+    """Dense projection. ``quantize=True`` marks the encoder
+    attention/MLP sites the ``weight_dtype`` seam swaps to QuantDense
+    (exactly the leaves tpudl.quant's BERT_QUANT_PATTERNS match);
+    pooler/classifier callers leave it False and always stay full
+    precision."""
+    if quantize and cfg.weight_dtype is not None:
+        from tpudl.quant.dense import QuantDense
+
+        return QuantDense(
+            features,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name=name,
+        )
     return nn.Dense(
         features,
         dtype=cfg.dtype,
@@ -131,17 +154,32 @@ class FusedBiasGeluDense(nn.Module):
     @nn.compact
     def __call__(self, x):
         from tpudl.ops.mlp_fused import bias_gelu
+        from tpudl.quant.dense import quant_dot
+        from tpudl.quant.quantize import is_quantized
 
         cfg = self.cfg
-        kernel = self.param(
-            "kernel", nn.initializers.normal(0.02),
-            (x.shape[-1], self.features),
+        # Read a quantized kernel around self.param (flax shape-checks
+        # stored params against the initializer; the (qvalues, qscale)
+        # pair is not the init-time kernel shape) — same dispatch as
+        # tpudl.quant.dense.QuantDense.
+        stored = (
+            self.get_variable("params", "kernel")
+            if self.has_variable("params", "kernel")
+            else None
         )
+        if is_quantized(stored):
+            kernel = stored
+        else:
+            kernel = self.param(
+                "kernel", nn.initializers.normal(0.02),
+                (x.shape[-1], self.features),
+            )
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
-        y = jax.lax.dot_general(
-            x.astype(cfg.dtype), kernel.astype(cfg.dtype),
-            (((x.ndim - 1,), (0,)), ((), ())),
-        )
+        # quant_dot dispatches on the kernel itself: a quantized pair
+        # runs the contraction-fused dequant (the weight_dtype seam), a
+        # plain kernel the exact pre-existing dot_general in cfg.dtype.
+        # The bias+GeLU epilogue is unchanged either way.
+        y = quant_dot(x, kernel, compute_dtype=cfg.dtype)
         return bias_gelu(y, bias, impl=self.impl)
 
 
@@ -184,9 +222,15 @@ class BertSelfAttention(nn.Module):
         cfg = self.cfg
         B, S, _ = hidden.shape
         shape = (B, S, cfg.num_heads, cfg.head_dim)
-        q = _dense(cfg, cfg.hidden_size, "query")(hidden).reshape(shape)
-        k = _dense(cfg, cfg.hidden_size, "key")(hidden).reshape(shape)
-        v = _dense(cfg, cfg.hidden_size, "value")(hidden).reshape(shape)
+        q = _dense(cfg, cfg.hidden_size, "query", quantize=True)(
+            hidden
+        ).reshape(shape)
+        k = _dense(cfg, cfg.hidden_size, "key", quantize=True)(
+            hidden
+        ).reshape(shape)
+        v = _dense(cfg, cfg.hidden_size, "value", quantize=True)(
+            hidden
+        ).reshape(shape)
         q = constrain(q, ("dp", "fsdp"), "sp", "tp", None)
         k = constrain(k, ("dp", "fsdp"), "sp", "tp", None)
         v = constrain(v, ("dp", "fsdp"), "sp", "tp", None)
@@ -204,7 +248,7 @@ class BertSelfAttention(nn.Module):
             dropout_exact=cfg.dropout_exact,
         )
         ctx = ctx.reshape(B, S, cfg.hidden_size)
-        out = _dense(cfg, cfg.hidden_size, "out")(ctx)
+        out = _dense(cfg, cfg.hidden_size, "out", quantize=True)(ctx)
         out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(out, deterministic=not train)
         return out
 
@@ -260,7 +304,9 @@ class BertLayer(nn.Module):
             inter = FusedBiasGeluDense(
                 cfg, cfg.intermediate_size, impl, name="intermediate"
             )(hidden)
-            out = _dense(cfg, cfg.hidden_size, "output")(inter)
+            out = _dense(cfg, cfg.hidden_size, "output", quantize=True)(
+                inter
+            )
             out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(
                 out, deterministic=not train
             )
@@ -273,9 +319,13 @@ class BertLayer(nn.Module):
                 name="attention_norm"
             )(hidden + attn_out).astype(cfg.dtype)
 
-            inter = _dense(cfg, cfg.intermediate_size, "intermediate")(hidden)
+            inter = _dense(
+                cfg, cfg.intermediate_size, "intermediate", quantize=True
+            )(hidden)
             inter = nn.gelu(inter, approximate=False)
-            out = _dense(cfg, cfg.hidden_size, "output")(inter)
+            out = _dense(cfg, cfg.hidden_size, "output", quantize=True)(
+                inter
+            )
             out = Dropout(cfg.hidden_dropout, exact=cfg.dropout_exact)(out, deterministic=not train)
             hidden = nn.LayerNorm(
                 epsilon=cfg.layer_norm_eps, dtype=jnp.float32,

@@ -17,7 +17,7 @@ from __future__ import annotations
 import dataclasses
 import re
 from functools import partial
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -53,6 +53,16 @@ class LlamaConfig:
     # These ops run per serve decode step, so the fused path cuts decode
     # TPOT alongside training step time.
     fused_ops: Any = False
+    # Low-precision weight tier (tpudl.quant): None (default) = plain
+    # nn.Dense projections, bit-identical to before the tier; "int8" /
+    # "fp8_e4m3" = attention+MLP projections become QuantDense, which
+    # serves the quantize_tree output (kernels carried as
+    # (qvalues, qscale) pairs, dequant fused into the contraction) and
+    # runs full-precision kernels through the exact nn.Dense math —
+    # same param-tree structure either way, so checkpoints round-trip.
+    # Norms/embeddings/lm_head always stay full precision. Serving
+    # entry: ServeSession.from_model(weight_dtype=...).
+    weight_dtype: Optional[str] = None
     # MoE (tpudl.ops.moe): >0 swaps the dense SwiGLU MLP for an
     # expert-parallel gated MoE in every block.
     moe_experts: int = 0
@@ -96,8 +106,26 @@ LLAMA_SIZES = {
 
 
 def _proj(cfg: LlamaConfig, features: int, name: str):
-    """Attention/MLP projection: plain Dense, or LoRADense when adapters
-    are on (cfg.lora_rank > 0)."""
+    """Attention/MLP projection: plain Dense, LoRADense when adapters
+    are on (cfg.lora_rank > 0), or QuantDense when the low-precision
+    weight seam is set (cfg.weight_dtype — serving only; the quantized
+    sites are exactly the leaves tpudl.quant's LLAMA_QUANT_PATTERNS
+    match)."""
+    if cfg.weight_dtype is not None:
+        if cfg.lora_rank > 0:
+            raise ValueError(
+                "weight_dtype and lora_rank are mutually exclusive — "
+                "merge the adapters before quantizing for serving"
+            )
+        from tpudl.quant.dense import QuantDense
+
+        return QuantDense(
+            features,
+            use_bias=False,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name=name,
+        )
     if cfg.lora_rank > 0:
         return LoRADense(
             features,

@@ -1,0 +1,158 @@
+"""Quantized matmul with dequantization fused into the contraction.
+
+The serving-path identity this module exploits: for symmetric
+per-output-channel quantization, ``x @ (q * scale) == (x @ q) * scale``
+— the scale broadcasts over the output channel, so it can be applied
+AFTER the contraction. The fused path therefore feeds the int8/fp8
+values straight into ``lax.dot_general(preferred_element_type=f32)``
+(mixed-dtype contraction, f32 accumulation) and pays one broadcast
+multiply on the [.., out] result; the full-precision weight matrix is
+never materialized, which is the whole bytes-moved point.
+
+Same ``impl=`` dispatch seam as tpudl.ops (norms.resolve_impl's
+shape): ``"fused"`` is the contraction-fused form above,
+``"reference"`` is the composite — dequantize the kernel, then the
+exact ``nn.Dense`` math — kept as the parity baseline (the two differ
+only by scale-multiply association, bounded by tests/test_quant.py).
+``"auto"`` resolves to fused everywhere: unlike the Pallas tier there
+is no interpret-mode cliff off-TPU, both forms are plain XLA.
+
+``QuantDense`` is the flax module the model ``weight_dtype`` seams
+swap in for ``nn.Dense`` at the projection sites. Its init declares
+the SAME params as ``nn.Dense`` (full-precision kernel [+ bias], same
+initializers), so the param tree structure is identical across modes;
+at apply time it dispatches on what the tree actually holds — a plain
+kernel runs bit-identical ``nn.Dense`` math, a quantized
+``{"qvalues","qscale"}`` dict runs the fused matmul. Biases and
+everything downstream stay full precision.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import dtypes as flax_dtypes
+from jax import lax
+
+from tpudl.quant.quantize import dequantize_leaf, is_quantized
+
+
+def resolve_impl(impl: str) -> str:
+    """``impl`` -> "fused" | "reference" (the tpudl.ops dispatch-seam
+    shape). "auto" = fused on every backend — both forms are plain
+    XLA, so there is no off-TPU interpret-mode penalty to dodge."""
+    if impl == "auto":
+        return "fused"
+    if impl not in ("fused", "reference"):
+        raise ValueError(
+            f"impl must be 'auto', 'fused' or 'reference', got {impl!r}"
+        )
+    return impl
+
+
+def quant_dot(
+    x: jax.Array,
+    kernel: Any,
+    *,
+    impl: str = "auto",
+    compute_dtype=None,
+    precision=None,
+) -> jax.Array:
+    """``x @ kernel`` for a quantized-or-plain kernel.
+
+    Quantized (``{"qvalues","qscale"}``): fused = mixed-dtype
+    ``dot_general(x, qvalues, preferred_element_type=f32)`` then one
+    per-output-channel scale multiply; reference = dequantize first,
+    contract in ``compute_dtype``. Plain array kernels contract in
+    ``compute_dtype`` directly (the nn.Dense shape). Returns
+    ``compute_dtype`` (default: ``x.dtype``)."""
+    if compute_dtype is None:
+        compute_dtype = x.dtype
+    dims = (((x.ndim - 1,), (0,)), ((), ()))
+    if not is_quantized(kernel):
+        return lax.dot_general(
+            x.astype(compute_dtype), kernel.astype(compute_dtype),
+            dims, precision=precision,
+        )
+    if resolve_impl(impl) == "reference":
+        w = dequantize_leaf(kernel, compute_dtype)
+        y = lax.dot_general(
+            x.astype(compute_dtype), w, dims, precision=precision
+        )
+        return y.astype(compute_dtype)
+    y = lax.dot_general(
+        x.astype(compute_dtype), kernel["qvalues"], dims,
+        precision=precision, preferred_element_type=jnp.float32,
+    )
+    return (y * kernel["qscale"]).astype(compute_dtype)
+
+
+class QuantDense(nn.Module):
+    """Drop-in ``nn.Dense`` whose kernel may arrive quantized.
+
+    Init-time params are IDENTICAL to ``nn.Dense`` (f32 kernel/bias,
+    same initializers) — the ``weight_dtype`` seam changes which module
+    runs, never the tree a checkpoint restores into. Serving passes
+    the ``tpudl.quant.quantize.quantize_tree`` output, whose matched
+    kernels are ``{"qvalues","qscale"}`` dicts; apply dispatches on
+    the stored value, so one module serves both precisions."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+    impl: str = "auto"
+    precision: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, inputs: jax.Array) -> jax.Array:
+        # A quantized kernel must be read around self.param: flax
+        # validates a stored param's shape against the initializer's
+        # abstract output, and the {"qvalues","qscale"} pair is not
+        # the init-time f32 kernel shape. Full-precision trees (and
+        # init itself) still flow through self.param unchanged.
+        stored = (
+            self.get_variable("params", "kernel")
+            if self.has_variable("params", "kernel")
+            else None
+        )
+        if is_quantized(stored):
+            kernel = stored
+        else:
+            kernel = self.param(
+                "kernel",
+                self.kernel_init,
+                (jnp.shape(inputs)[-1], self.features),
+            )
+        bias = (
+            self.param("bias", self.bias_init, (self.features,))
+            if self.use_bias
+            else None
+        )
+        if is_quantized(kernel):
+            compute = self.dtype or inputs.dtype
+            y = quant_dot(
+                inputs, kernel, impl=self.impl, compute_dtype=compute,
+                precision=self.precision,
+            )
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            return y
+        # Full-precision path: nn.Dense's exact math (promote_dtype,
+        # dot_general, broadcast bias) so weight_dtype=None-shaped
+        # checkpoints run bit-identical to the plain module.
+        inputs, kernel, bias = flax_dtypes.promote_dtype(
+            inputs, kernel, bias, dtype=self.dtype
+        )
+        y = lax.dot_general(
+            inputs, kernel,
+            (((inputs.ndim - 1,), (0,)), ((), ())),
+            precision=self.precision,
+        )
+        if bias is not None:
+            y = y + jnp.reshape(bias, (1,) * (y.ndim - 1) + (-1,))
+        return y

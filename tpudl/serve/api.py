@@ -223,6 +223,7 @@ class ServeSession:
         page_size: Optional[int] = None,
         kv_dtype: Optional[str] = None,
         num_pages: Optional[int] = None,
+        weight_dtype: Optional[str] = None,
         **kwargs,
     ) -> "ServeSession":
         """Live-model session: jit the prefill/decode contracts (batch 1
@@ -238,13 +239,31 @@ class ServeSession:
         the decode gather — ~4x the resident slots per byte.
         ``page_size`` (``TPUDL_SERVE_PAGE_SIZE``, default 16) and
         ``num_pages`` (default: capacity parity with the dense cache)
-        size the pool."""
+        size the pool.
+
+        ``weight_dtype="int8"``/``"fp8_e4m3"`` (or
+        ``TPUDL_SERVE_WEIGHT_DTYPE``) serves a QUANTIZED weight tree
+        (tpudl.quant.quantize_model: attention/MLP projection kernels
+        stored low precision with dequant fused into the contraction;
+        norms/embeddings/head stay full) — the decode-TPOT lever that
+        composes with the int8 KV cache above; already-quantized
+        params pass through untouched. Parity contract:
+        ``assert_serving_parity(..., atol=...)`` vs the full-precision
+        model, same as the quantized-KV tier."""
         from tpudl.models.generate import (
             decode_fn,
             paged_decode_fn,
             prefill_fn,
         )
 
+        if weight_dtype is None:
+            weight_dtype = (
+                os.environ.get("TPUDL_SERVE_WEIGHT_DTYPE") or None
+            )
+        if weight_dtype is not None:
+            from tpudl.quant import quantize_model
+
+            model, params = quantize_model(model, params, weight_dtype)
         num_slots = (
             num_slots
             if num_slots is not None
