@@ -450,6 +450,18 @@ def _bench_serve_replicas():
     return measure_serve_replicas()
 
 
+def _bench_fleet():
+    """Fleet observability + autoscaling tier (benchmarks/
+    serve_load.py): the scale-up-to-burn-clear recovery time of the
+    SLO-driven autoscaler under 2x overload, and the FleetMonitor's
+    per-cycle real-HTTP scrape overhead. Banked by
+    scripts/bench_regress.py from r06 onward (lower is better for
+    both)."""
+    from benchmarks.serve_load import measure_fleet
+
+    return measure_fleet()
+
+
 def _bench_parity_grid():
     """Low-precision serving grid (benchmarks/parity_grid.py): every
     precision x backend cell parity-gated against the f32 reference,
@@ -582,6 +594,15 @@ def main(argv=None):
         traceback.print_exc()
         serve_replicas = {}
     try:
+        fleet = _bench_fleet()
+    except Exception:
+        import sys
+        import traceback
+
+        print("fleet autoscale bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        fleet = {}
+    try:
         ft = _bench_ft()
     except Exception:
         import sys
@@ -706,6 +727,15 @@ def main(argv=None):
         ),
         "serve_kv_slots_per_gb": serve_replicas.get(
             "serve_kv_slots_per_gb"
+        ),
+        # Fleet observability + autoscaling tier (tpudl.obs.fleet +
+        # tpudl.serve.autoscale via benchmarks/serve_load.py): how
+        # long the SLO-driven control loop takes from scale-up to
+        # burn-clear under 2x overload, and the FleetMonitor's
+        # per-cycle HTTP scrape cost over live exporters.
+        "autoscale_recovery_s": fleet.get("autoscale_recovery_s"),
+        "fleet_scrape_overhead_ms": fleet.get(
+            "fleet_scrape_overhead_ms"
         ),
         # Fault tolerance (tpudl.ft via benchmarks/
         # ft_recovery.py): the async checkpoint's mean on-step

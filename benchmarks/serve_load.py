@@ -34,15 +34,26 @@ layout. ``run_router_overload`` drives open-loop overload against a
 TTFT SloMonitor per replica: sheds must come from SLO burn (not queue
 overflow) with admitted p99 TTFT inside the objective.
 
+``run_autoscale_recovery`` (``--autoscale``) is the fleet-control
+acceptance: 2x-capacity open-loop overload on a 2-replica fleet with
+per-replica TTFT SLO monitors -> the FleetMonitor reports the burn ->
+the Autoscaler adds a third replica over the SAME compiled programs ->
+post-scale-up admitted p99 TTFT recovers under the objective with zero
+``shed_slo`` -> sustained idle drains the fleet back to 2 with every
+Result delivered.
+
     python -m benchmarks.serve_load                # one JSON blob
     python -m benchmarks.serve_load --rates 5 20 80  # + open-loop sweep
     python -m benchmarks.serve_load --replicas 1 2 4 # + scaling curve
     python -m benchmarks.serve_load --overload       # + SLO shed run
+    python -m benchmarks.serve_load --autoscale      # + fleet control
 
 bench.py records ``serve_tokens_per_sec`` / ``serve_p99_ttft_ms`` /
-``serve_vs_static_batching`` from ``measure_serve()`` and
+``serve_vs_static_batching`` from ``measure_serve()``,
 ``serve_tokens_per_sec_2rep`` / ``serve_scaling_efficiency`` /
-``serve_kv_slots_per_gb`` from ``measure_serve_replicas()`` each round.
+``serve_kv_slots_per_gb`` from ``measure_serve_replicas()``, and
+``autoscale_recovery_s`` / ``fleet_scrape_overhead_ms`` from
+``measure_fleet()`` each round.
 """
 
 from __future__ import annotations
@@ -522,6 +533,309 @@ def run_router_overload(
     return stats
 
 
+def run_autoscale_recovery(
+    num_replicas: int = 2,
+    max_replicas: int = 3,
+    offered_rate: float = 300.0,
+    n_requests: int = 120,
+    recovery_rate: float = 60.0,
+    n_recovery_requests: int = 30,
+    ttft_objective_ms: float = 300.0,
+    sim_step_ms: float = 4.0,
+    num_slots: int = 4,
+    seed: int = 0,
+    check: bool = True,
+    shed_margin: float = 0.6,
+) -> dict:
+    """The ISSUE-10 acceptance scenario end to end: 2x-capacity
+    open-loop overload on a ``num_replicas`` fleet with per-replica
+    TTFT SLO monitors and a FleetMonitor over the process's live
+    telemetry -> the burn sustains -> the Autoscaler adds a replica
+    (spawned over the SAME shared compiled programs — scale-up costs
+    no compilation) -> once the burn clears, admitted traffic's p99
+    TTFT sits back under the objective with ZERO ``shed_slo`` results
+    in the post-scale-up phase -> sustained idle drains the fleet back
+    to ``num_replicas`` with every outstanding Result delivered.
+
+    Reports ``autoscale_recovery_s``: scale-up action to burn-clear —
+    the time the control loop takes to actually relieve an overload,
+    the number a capacity runbook quotes."""
+    from tpudl.obs import exporter as obs_exporter
+    from tpudl.obs.fleet import FleetMonitor
+    from tpudl.obs.slo import Objective, SloMonitor
+    from tpudl.serve import AutoscaleConfig, Autoscaler, Replica, Router
+
+    programs = build_programs(num_slots, paged=True)
+    warm = session_from_programs(programs)
+    warmup_session(warm)
+    monitors: List = []
+
+    def make_replica(name: str) -> "Replica":
+        monitor = SloMonitor([
+            Objective(
+                name=f"ttft_{name}",
+                metric="serve_ttft_ms",
+                threshold=shed_margin * ttft_objective_ms,
+                quantile=0.95,
+                window_s=4.0,
+                fast_window_s=0.5,
+                min_count=3,
+            )
+        ])
+        monitors.append(monitor)
+        return Replica(
+            name,
+            session_from_programs(
+                programs,
+                sim_step_s=1e-3 * sim_step_ms,
+                slo=monitor,
+                queue_capacity=4 * n_requests,
+            ),
+        )
+
+    exporter = obs_exporter.ObsExporter(port=0).start()
+    fleet = FleetMonitor(
+        {"serving": exporter.snapshot}, scrape_interval_s=0.1
+    )
+    requests = make_requests(
+        n_requests, seed, deadline_s=None, best_effort_every=3
+    )
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(
+        rng.exponential(1.0 / offered_rate, size=len(requests))
+    )
+    results: Dict = {}
+    try:
+        with Router(
+            [make_replica(f"r{i}") for i in range(num_replicas)]
+        ) as router:
+            scaler = Autoscaler(
+                router,
+                make_replica,
+                AutoscaleConfig(
+                    min_replicas=num_replicas,
+                    max_replicas=max_replicas,
+                    up_sustain_s=0.2,
+                    down_sustain_s=0.5,
+                    cooldown_s=1.0,
+                    idle_busy_frac=0.05,
+                ),
+                fleet=fleet,
+            )
+            # -- phase 1: overload ---------------------------------------
+            # The control loop ticks THROUGHOUT: per arrival while
+            # submitting, then per poll while the backlog drains — the
+            # burn peaks during the drain, which is exactly when the
+            # scale-up must fire.
+            t0 = time.perf_counter()
+            scale_up_at = None
+            fleet_burned = False
+
+            def tick():
+                nonlocal scale_up_at, fleet_burned
+                action = scaler.evaluate()
+                if (
+                    scale_up_at is None
+                    and action is not None
+                    and action["action"] == "scale_up"
+                ):
+                    scale_up_at = time.perf_counter()
+                if not fleet_burned:
+                    # The fleet-plane confirmation of the burn (scrape
+                    # time-gated inside the monitor).
+                    fleet_burned = bool(fleet.burning_sources())
+
+            for request, due in zip(requests, arrivals):
+                lag = due - (time.perf_counter() - t0)
+                if lag > 0:
+                    time.sleep(lag)
+                router.submit(request)
+                tick()
+            while time.perf_counter() - t0 < 600.0:
+                results.update(router.poll())
+                tick()
+                if len(results) >= n_requests:
+                    break
+                time.sleep(0.002)
+            # -- burn clear: the recovery clock --------------------------
+            burn_clear_at = None
+            t_wait = time.perf_counter()
+            while time.perf_counter() - t_wait < 30.0:
+                if not any(m.burning_names() for m in monitors):
+                    burn_clear_at = time.perf_counter()
+                    break
+                time.sleep(0.02)
+            recovery_s = (
+                burn_clear_at - scale_up_at
+                if scale_up_at is not None and burn_clear_at is not None
+                else None
+            )
+            # -- phase 2: post-scale-up traffic under the objective ------
+            import dataclasses as _dc
+
+            phase2 = [
+                _dc.replace(r, request_id=f"p2-{r.request_id}")
+                for r in make_requests(
+                    n_recovery_requests, seed + 1, deadline_s=None,
+                    best_effort_every=3,
+                )
+            ]
+            gaps2 = np.cumsum(
+                rng.exponential(1.0 / recovery_rate, size=len(phase2))
+            )
+            t2 = time.perf_counter()
+            for request, due in zip(phase2, gaps2):
+                lag = due - (time.perf_counter() - t2)
+                if lag > 0:
+                    time.sleep(lag)
+                router.submit(request)
+            phase2_results = router.collect(timeout_s=600.0)
+            results.update(phase2_results)
+            stats2 = _latency_stats(phase2_results)
+            reasons2: Dict[str, int] = {}
+            for r in phase2_results.values():
+                reasons2[r.finish_reason] = (
+                    reasons2.get(r.finish_reason, 0) + 1
+                )
+            # -- phase 3: sustained idle -> drain-then-remove ------------
+            t3 = time.perf_counter()
+            while (
+                scaler.num_scale_downs < scaler.num_scale_ups
+                and time.perf_counter() - t3 < 60.0
+            ):
+                scaler.evaluate()
+                time.sleep(0.05)
+            final_replicas = router.load_report()["active_replicas"]
+            # -- parity through the shrunk fleet -------------------------
+            # The drained fleet still serves generate()-identical greedy
+            # tokens (the acceptance's "parity intact").
+            parity_reqs = [
+                _dc.replace(r, request_id=f"parity-{r.request_id}")
+                for r in make_requests(4, seed + 2, deadline_s=None)
+            ]
+            parity_results = router.serve(parity_reqs, timeout_s=600.0)
+            parity_ok = True
+            if check:
+                from tpudl.models.generate import generate
+
+                import jax.numpy as jnp
+
+                for req in parity_reqs:
+                    want = np.asarray(generate(
+                        programs["model"], programs["params"],
+                        jnp.asarray(req.input_ids, jnp.int32)[None, :],
+                        max_new_tokens=req.max_new_tokens,
+                    ))[0]
+                    got = np.asarray(
+                        parity_results[req.request_id].tokens
+                    )
+                    parity_ok = parity_ok and bool(
+                        (got == want[: got.shape[0]]).all()
+                    )
+            out = {
+                "mode": "autoscale_recovery",
+                "replicas_initial": num_replicas,
+                "replicas_peak": num_replicas + scaler.num_scale_ups,
+                "replicas_final": final_replicas,
+                "scale_ups": scaler.num_scale_ups,
+                "scale_downs": scaler.num_scale_downs,
+                "actions": list(scaler.history),
+                "autoscale_recovery_s": (
+                    round(recovery_s, 4) if recovery_s is not None else None
+                ),
+                "fleet_burned": fleet_burned,
+                "overload": _latency_stats(
+                    {k: v for k, v in results.items()
+                     if k not in phase2_results}
+                ),
+                "post_scale_up": {**stats2, "finish_reasons": reasons2},
+                "parity_ok": parity_ok,
+                "delivered": len(results),
+                "submitted": n_requests + n_recovery_requests,
+            }
+    finally:
+        exporter.close()
+    if check:
+        assert out["scale_ups"] >= 1, (
+            f"overload never triggered a scale-up "
+            f"(actions: {out['actions']})"
+        )
+        assert out["autoscale_recovery_s"] is not None, (
+            "the SLO burn never cleared after scale-up"
+        )
+        assert reasons2.get("shed_slo", 0) == 0, (
+            f"post-scale-up traffic still shed on SLO burn "
+            f"(reasons: {reasons2}) — the added replica did not "
+            f"relieve the overload"
+        )
+        p99 = stats2["ttft"]["p99_ms"]
+        assert p99 is not None and p99 <= ttft_objective_ms, (
+            f"post-scale-up admitted p99 TTFT {p99} ms blew the "
+            f"{ttft_objective_ms} ms objective"
+        )
+        assert out["scale_downs"] >= 1, (
+            "sustained idle never drained the scaled-up replica"
+        )
+        assert out["replicas_final"] == num_replicas, (
+            f"fleet did not return to {num_replicas} replicas "
+            f"(final: {out['replicas_final']})"
+        )
+        assert out["delivered"] == out["submitted"], (
+            f"dropped results: {out['delivered']}/{out['submitted']} "
+            f"delivered — a drain lost in-flight work"
+        )
+        assert out["parity_ok"], (
+            "the shrunk fleet no longer serves generate()-identical "
+            "greedy tokens — scale churn corrupted serving state"
+        )
+    return out
+
+
+def measure_fleet_scrape(
+    n_sources: int = 2, n_scrapes: int = 20
+) -> dict:
+    """Mean FleetMonitor scrape cost over real HTTP against live
+    exporters — the overhead the fleet plane adds per poll cycle
+    (``fleet_scrape_overhead_ms``, banked from r06)."""
+    from tpudl.obs import exporter as obs_exporter
+    from tpudl.obs.fleet import FleetMonitor
+
+    exporters = [
+        obs_exporter.ObsExporter(port=0).start() for _ in range(n_sources)
+    ]
+    try:
+        fleet = FleetMonitor({
+            f"s{i}": f"http://127.0.0.1:{ex.port}/snapshot"
+            for i, ex in enumerate(exporters)
+        })
+        fleet.scrape()  # connection warmup outside the timed window
+        t0 = time.perf_counter()
+        for _ in range(n_scrapes):
+            fleet.scrape(force=True)
+        elapsed = time.perf_counter() - t0
+        snap = fleet.fleet_snapshot()
+        assert snap["sources_healthy"] == n_sources, snap
+    finally:
+        for ex in exporters:
+            ex.close()
+    return {
+        "n_sources": n_sources,
+        "n_scrapes": n_scrapes,
+        "fleet_scrape_overhead_ms": round(1e3 * elapsed / n_scrapes, 3),
+    }
+
+
+def measure_fleet() -> dict:
+    """The bench.py entry for the fleet tier: scale-up-to-burn-clear
+    recovery time and the FleetMonitor's per-cycle scrape cost."""
+    scrape = measure_fleet_scrape()
+    recovery = run_autoscale_recovery()
+    return {
+        "autoscale_recovery_s": recovery["autoscale_recovery_s"],
+        "fleet_scrape_overhead_ms": scrape["fleet_scrape_overhead_ms"],
+    }
+
+
 def kv_capacity_report(
     num_slots: int = 8,
     max_seq_len: int = MAX_SEQ_LEN,
@@ -645,6 +959,15 @@ def main(argv=None) -> int:
         help="run the open-loop router overload: SLO-burn shedding "
         "with admitted p99 TTFT inside the objective (asserted)",
     )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="run the autoscale-recovery acceptance: 2x-capacity "
+        "overload on a 2-replica fleet -> FleetMonitor reports burn "
+        "-> the Autoscaler adds a replica -> admitted p99 TTFT "
+        "recovers under the objective with zero shed_slo after "
+        "scale-up -> sustained idle drains back to 2 (all asserted), "
+        "plus the FleetMonitor HTTP scrape overhead",
+    )
     args = ap.parse_args(argv)
 
     out = compare_continuous_vs_static(args.requests, args.slots, args.seed)
@@ -672,6 +995,9 @@ def main(argv=None) -> int:
         )
     if args.overload:
         out["router_overload"] = run_router_overload()
+    if args.autoscale:
+        out["fleet_scrape"] = measure_fleet_scrape()
+        out["autoscale_recovery"] = run_autoscale_recovery()
     print(json.dumps(out, indent=2))
     return 0
 

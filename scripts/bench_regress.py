@@ -84,6 +84,12 @@ NOISE_BAND_FLOORS = {
     "checkpoint_sync_save_ms": 0.50,
     "recovery_time_sec": 0.50,
     "step_dispatch_overhead_ms": 1.00,
+    # Fleet-tier keys (benchmarks/serve_load.py --autoscale, banked
+    # from r06). Recovery rides SLO window drains + thread scheduling
+    # on 1 vCPU; the scrape is two localhost HTTP round trips whose
+    # tail the container's scheduler owns.
+    "autoscale_recovery_s": 0.60,
+    "fleet_scrape_overhead_ms": 0.60,
 }
 DEFAULT_BAND_FLOOR = 0.08
 
@@ -96,6 +102,8 @@ LOWER_IS_BETTER = {
     "checkpoint_sync_save_ms",
     "recovery_time_sec",
     "step_dispatch_overhead_ms",
+    "autoscale_recovery_s",
+    "fleet_scrape_overhead_ms",
 }
 
 #: Non-measurement keys in a bench line: identifiers, config echoes,

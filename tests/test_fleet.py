@@ -1,0 +1,489 @@
+"""Fleet observability plane (ISSUE 10 tentpole, tpudl.obs.fleet).
+
+The contract under test: a FleetMonitor scraping N live exporters over
+REAL HTTP merges their registries into ONE labeled Prometheus
+exposition (``serve_slots_busy{source="a"}`` — one TYPE line per
+metric, one series per source, no mangled names) and a health rollup
+in which one sick member is a sick fleet; each member's ``/snapshot``
+names its active span stream so trace discovery needs no out-of-band
+config; and ``report.py --request`` / ``--fleet`` stitch records
+merged from SEVERAL processes' streams into one router-door -> queue
+-> prefill -> decode timeline whose hop decomposition (all durations,
+never cross-clock timestamp subtraction) sums to the router-measured
+TTFT — with a loud "partial trace" warning when a hop named by a
+router event has no stream on disk."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+import tpudl.obs as obs
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import exporter as obs_exporter
+from tpudl.obs import report as obs_report
+from tpudl.obs.fleet import FleetMonitor, render_fleet_prometheus
+from tpudl.obs.spans import SpanRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("TPUDL_OBS_PORT", raising=False)
+    monkeypatch.delenv("TPUDL_OBS_DIR", raising=False)
+    obs.disable()
+    obs_counters.registry().reset()
+    obs_exporter.stop_exporter()
+    obs_exporter._reset_health_for_tests()
+    yield
+    obs.disable()
+    obs_counters.registry().reset()
+    obs_exporter.stop_exporter()
+    obs_exporter._reset_health_for_tests()
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# The PR-6 conformance regex, verbatim: labeled series must still be
+# legal exposition lines.
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*="
+    r"\"[^\"]*\")*\})?"
+    r" (NaN|[+-]?Inf|[+-]?[0-9]*\.?[0-9]+([eE][+-]?[0-9]+)?)$"
+)
+
+
+# ---------------------------------------------------------------------------
+# render_prometheus label support (satellite: unlabeled stays
+# byte-identical)
+# ---------------------------------------------------------------------------
+
+
+def _sample_snapshot():
+    reg = obs_counters.Registry()
+    reg.counter("bytes_ingested").inc(1234)
+    reg.gauge("serve_slots_busy").set(3)
+    h = reg.histogram("serve_ttft_ms")
+    for v in [10.0, 20.0, 30.0, 40.0]:
+        h.observe(v)
+    return reg.snapshot()
+
+
+def test_render_prometheus_unlabeled_output_byte_identical():
+    """The pre-label renderer's exact bytes, locked down: the label
+    feature must not move a single character of the unlabeled path."""
+    snap = _sample_snapshot()
+    text = obs_exporter.render_prometheus(snap, {"train_loop": 2.5})
+    assert text == (
+        "# TYPE bytes_ingested counter\n"
+        "bytes_ingested 1234.0\n"
+        "# TYPE serve_slots_busy gauge\n"
+        "serve_slots_busy 3.0\n"
+        "# TYPE serve_ttft_ms summary\n"
+        'serve_ttft_ms{quantile="0.5"} 25.0\n'
+        'serve_ttft_ms{quantile="0.95"} 38.5\n'
+        'serve_ttft_ms{quantile="0.99"} 39.699999999999996\n'
+        "serve_ttft_ms_sum 100.0\n"
+        "serve_ttft_ms_count 4\n"
+        "# TYPE train_loop_heartbeat_age_s gauge\n"
+        "train_loop_heartbeat_age_s 2.5\n"
+    )
+    # labels=None and labels={} are the same (byte-identical) path.
+    assert obs_exporter.render_prometheus(snap, labels={}) == (
+        obs_exporter.render_prometheus(snap)
+    )
+
+
+def test_render_prometheus_labels_attach_to_every_series():
+    snap = _sample_snapshot()
+    text = obs_exporter.render_prometheus(
+        snap, {"train_loop": 2.5}, labels={"source": "replica1"}
+    )
+    lines = text.strip().splitlines()
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), line
+        assert 'source="replica1"' in line, line
+    assert 'serve_slots_busy{source="replica1"} 3.0' in lines
+    # Quantile rows merge the label set with their quantile label.
+    assert (
+        'serve_ttft_ms{quantile="0.5",source="replica1"} 25.0' in lines
+    )
+    assert 'serve_ttft_ms_count{source="replica1"} 4' in lines
+    # Label values are escaped, label names validated.
+    esc = obs_exporter.render_prometheus(
+        {"gauges": {"g": 1.0}}, labels={"source": 'a"b\\c'}
+    )
+    assert 'g{source="a\\"b\\\\c"} 1.0' in esc
+    with pytest.raises(ValueError, match="label name"):
+        obs_exporter.render_prometheus(
+            {"gauges": {"g": 1.0}}, labels={"bad-name": "x"}
+        )
+
+
+def test_render_fleet_prometheus_groups_type_lines_once():
+    snap = _sample_snapshot()
+    text = render_fleet_prometheus({"b": snap, "a": snap})
+    lines = text.strip().splitlines()
+    # One TYPE line per metric, both sources' series under it.
+    assert lines.count("# TYPE serve_slots_busy gauge") == 1
+    i = lines.index("# TYPE serve_slots_busy gauge")
+    assert lines[i + 1] == 'serve_slots_busy{source="a"} 3.0'
+    assert lines[i + 2] == 'serve_slots_busy{source="b"} 3.0'
+    for line in lines:
+        if not line.startswith("#"):
+            assert _PROM_LINE.match(line), line
+
+
+# ---------------------------------------------------------------------------
+# The two-exporter real-HTTP scrape -> merged labeled /metrics
+# (the satellite's acceptance test)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_monitor_merges_two_real_exporters_over_http():
+    reg_a, reg_b = obs_counters.Registry(), obs_counters.Registry()
+    reg_a.gauge("serve_slots_busy").set(1)
+    reg_b.gauge("serve_slots_busy").set(4)
+    reg_a.counter("serve_requests_completed").inc(10)
+    reg_b.counter("serve_requests_completed").inc(20)
+    ex_a = obs_exporter.ObsExporter(port=0, registry=reg_a).start()
+    ex_b = obs_exporter.ObsExporter(port=0, registry=reg_b).start()
+    fleet = FleetMonitor({
+        "a": f"http://127.0.0.1:{ex_a.port}/snapshot",
+        "b": f"http://127.0.0.1:{ex_b.port}/snapshot",
+    }, scrape_interval_s=0.0)
+    try:
+        fleet.start(port=0)
+        status, text = _get(f"http://127.0.0.1:{fleet.port}/metrics")
+        assert status == 200
+        lines = text.strip().splitlines()
+        for line in lines:
+            if not line.startswith("#"):
+                assert _PROM_LINE.match(line), line
+        assert 'serve_slots_busy{source="a"} 1.0' in lines
+        assert 'serve_slots_busy{source="b"} 4.0' in lines
+        assert 'serve_requests_completed{source="a"} 10.0' in lines
+        assert 'serve_requests_completed{source="b"} 20.0' in lines
+        # The fleet's own plane: rollup + per-source scrape gauges.
+        assert "fleet_sources_total 2.0" in lines
+        assert "fleet_sources_healthy 2.0" in lines
+        assert 'fleet_source_up{source="a"} 1.0' in lines
+        assert any(
+            l.startswith('fleet_scrape_age_s{source="a"}') for l in lines
+        )
+        status, body = _get(f"http://127.0.0.1:{fleet.port}/fleet")
+        rollup = json.loads(body)
+        assert rollup["healthy"] is True
+        assert rollup["sources"]["a"]["ok"] is True
+        status, _ = _get(f"http://127.0.0.1:{fleet.port}/healthz")
+        assert status == 200
+
+        # One member dies: its last-good metrics stay visible (age
+        # says how stale), but the rollup flips and /healthz probes
+        # 503 — one sick member is a sick fleet.
+        ex_b.close()
+        fleet.scrape(force=True)
+        _, text = _get(f"http://127.0.0.1:{fleet.port}/metrics")
+        lines = text.strip().splitlines()
+        assert 'serve_slots_busy{source="b"} 4.0' in lines  # last good
+        assert 'fleet_source_up{source="b"} 0.0' in lines
+        assert any(
+            l.startswith('fleet_scrape_failures_total{source="b"} ')
+            and not l.endswith(" 0.0")
+            for l in lines
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{fleet.port}/healthz", timeout=10.0
+            )
+        assert ei.value.code == 503
+        rollup = json.load(ei.value)
+        assert rollup["healthy"] is False
+        assert rollup["sources"]["b"]["healthy"] is False
+        assert rollup["sources"]["b"]["error"]
+    finally:
+        fleet.close()
+        ex_a.close()
+        ex_b.close()
+
+
+def test_fleet_monitor_in_process_sources_and_membership():
+    reg = obs_counters.Registry()
+    reg.gauge("g").set(7)
+    ex = obs_exporter.ObsExporter(port=0, registry=reg)
+    fleet = FleetMonitor({"self": ex.snapshot}, scrape_interval_s=0.0)
+    snap = fleet.fleet_snapshot()
+    assert snap["healthy"] is True and snap["sources_total"] == 1
+    assert 'g{source="self"} 7.0' in fleet.metrics_text()
+    fleet.add_source("other", lambda: {"registry": {"gauges": {"g": 9}}})
+    assert 'g{source="other"} 9.0' in fleet.metrics_text()
+    fleet.remove_source("other")
+    assert "other" not in fleet.fleet_snapshot()["sources"]
+    with pytest.raises(ValueError, match="at least one source"):
+        FleetMonitor({})
+
+
+def test_fleet_rollup_reports_burning_member():
+    """A member whose health names a burning SLO objective surfaces in
+    burning_sources — the autoscaler's cross-process pressure signal."""
+    def snapshot():
+        return {
+            "registry": {},
+            "health": {
+                "healthy": False,
+                "sources": {
+                    "slo": {"healthy": False, "burning": ["ttft_p99"]},
+                },
+            },
+        }
+
+    fleet = FleetMonitor({"replica1": snapshot}, scrape_interval_s=0.0)
+    snap = fleet.fleet_snapshot()
+    assert snap["burning_sources"] == ["replica1"]
+    assert snap["sources"]["replica1"]["burning"] == ["ttft_p99"]
+    assert snap["healthy"] is False
+    assert fleet.burning_sources() == ["replica1"]
+
+
+# ---------------------------------------------------------------------------
+# /snapshot span-path discovery (satellite) -> fleet trace stitching
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_names_span_stream_and_fleet_discovers_it(tmp_path):
+    import os
+
+    rec = obs.enable(str(tmp_path / "obs"))
+    rec.event("request_routed", "serve_request", request_id="r1",
+              replica="r0")
+    ex = obs_exporter.ObsExporter(port=0)
+    snap = ex.snapshot()
+    assert snap["span_path"] == os.path.abspath(rec.path)
+    fleet = FleetMonitor({"router": ex.snapshot}, scrape_interval_s=0.0)
+    assert fleet.trace_paths() == {"router": os.path.abspath(rec.path)}
+    records = fleet.trace_records()
+    assert any(
+        r.get("name") == "request_routed" and r.get("request_id") == "r1"
+        for r in records
+    )
+    # Without recording active there is no stream to discover.
+    obs.disable()
+    assert ex.snapshot()["span_path"] is None
+
+
+# ---------------------------------------------------------------------------
+# Cross-process --request stitching (satellite: merge all streams,
+# decomposition sums to the router TTFT, partial-trace warning)
+# ---------------------------------------------------------------------------
+
+
+def _write_fleet_streams(tmp_path, with_replica_stream=True):
+    """Synthesize a two-process fleet trace: the ROUTER process's
+    stream (door + failover-free) and the REPLICA process's stream
+    (inbox dequeue, admission, prefill, decode, served, complete) with
+    DISJOINT clock epochs — the stitcher must never subtract across
+    them. Durations are the ground truth:
+      inbox 0.010 + queue 0.020 + prefill 0.050 = router TTFT 0.080
+    """
+    obs_dir = tmp_path / "fleet-obs"
+    obs_dir.mkdir()
+    router = SpanRecorder(
+        str(obs_dir / "spans-router-p0-100.jsonl"),
+        host="router-host", process=0,
+    )
+    router.event(
+        "request_routed", "serve_request", request_id="rq",
+        replica="rep1", priority=0,
+    )
+    router.close()
+    if not with_replica_stream:
+        return str(obs_dir)
+    t = [1000.0]  # a clock epoch unrelated to the router's
+    rep = SpanRecorder(
+        str(obs_dir / "spans-rep1-p0-200.jsonl"),
+        clock=lambda: t[0], host="rep1-host", process=0,
+    )
+    rep.event(
+        "replica_dequeue", "serve_request", request_id="rq",
+        replica="rep1", inbox_wait_s=0.010,
+    )
+    rep.event(
+        "request_queued", "serve_request", request_id="rq",
+        req_priority=0, depth=1,
+    )
+    t[0] = 1000.020
+    rep.record("prefill", "serve_prefill", 1000.020, 0.050,
+               {"request_id": "rq", "slot": 0,
+                "queue_wait_s": 0.020})
+    t[0] = 1000.070
+    rep.record("decode_step", "serve_decode", 1000.072, 0.004,
+               {"busy": 1, "rids": ["rq"]})
+    rep.record("decode_step", "serve_decode", 1000.078, 0.004,
+               {"busy": 1, "rids": ["rq"]})
+    t[0] = 1000.082
+    rep.event(
+        "request_complete", "serve_request", request_id="rq",
+        finish_reason="length", ttft_s=0.070, tpot_s=0.006,
+        queue_wait_s=0.020, generation_s=0.012, num_tokens=3,
+    )
+    rep.event(
+        "request_served", "serve_request", request_id="rq",
+        replica="rep1", finish_reason="length",
+        inbox_wait_s=0.010, router_ttft_s=0.080,
+    )
+    rep.close()
+    return str(obs_dir)
+
+
+def test_cross_process_request_stitch_decomposition_sums(tmp_path):
+    obs_dir = _write_fleet_streams(tmp_path)
+    records = obs_report.load_records([obs_dir])  # merges BOTH streams
+    tl = obs_report.build_request_timeline(records, "rq")
+    assert tl["warnings"] == []
+    assert tl["hops"]["routed"] is True
+    assert tl["hops"]["replica"] == "rep1"
+    assert tl["hops"]["multi_process"] is True
+    assert len(tl["hops"]["processes"]) == 2
+    # Logical hop order, never cross-clock timestamp order (the router
+    # epoch is near 0, the replica's near 1000 — ts-sorting would put
+    # the door LAST).
+    whats = [e["what"] for e in tl["timeline"]]
+    assert whats == [
+        "routed", "replica_dequeue", "queued", "prefill",
+        "decode_chunk", "decode_chunk", "served", "complete",
+    ]
+    d = tl["decomposition"]
+    # The acceptance identity: hop durations sum to the
+    # router-measured TTFT.
+    assert d["inbox_wait_s"] == pytest.approx(0.010)
+    assert d["router_ttft_s"] == pytest.approx(0.080)
+    assert (
+        d["inbox_wait_s"] + d["queue_wait_s"] + d["prefill_s"]
+        == pytest.approx(d["router_ttft_s"], rel=1e-6)
+    )
+    assert d["router_accounted_s"] == pytest.approx(0.080, rel=1e-6)
+
+
+def test_partial_trace_warning_when_hop_stream_missing(tmp_path, capsys):
+    """The satellite's failure mode: the router stream names replica
+    'rep1' but that process's span file never made it into the merge —
+    the stitch must WARN loudly, not render a silently-empty trace."""
+    obs_dir = _write_fleet_streams(tmp_path, with_replica_stream=False)
+    records = obs_report.load_records([obs_dir])
+    tl = obs_report.build_request_timeline(records, "rq")
+    assert any("partial trace" in w for w in tl["warnings"])
+    assert any("rep1" in w for w in tl["warnings"])
+    assert any("no completion event" in w for w in tl["warnings"])
+    # And the CLI prints it.
+    assert obs_report.main([obs_dir, "--request", "rq"]) == 0
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "partial trace" in out
+
+
+def test_report_fleet_cli(tmp_path, capsys):
+    obs_dir = _write_fleet_streams(tmp_path)
+    assert obs_report.main([obs_dir, "--fleet"]) == 0
+    out = capsys.readouterr().out
+    assert "tpudl fleet report" in out
+    assert "2 process stream(s)" in out
+    assert "router TTFT" in out
+    assert "replica inbox wait" in out
+    assert "PARTIAL TRACES" not in out
+    # --json round-trips the structure.
+    assert obs_report.main([obs_dir, "--fleet", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["num_requests"] == 1
+    assert rep["router_ttft"]["count"] == 1
+    assert rep["router_ttft"]["mean_ms"] == pytest.approx(80.0)
+    assert rep["partial_traces"] == {}
+
+
+def test_report_fleet_flags_partial_traces(tmp_path, capsys):
+    obs_dir = _write_fleet_streams(tmp_path, with_replica_stream=False)
+    assert obs_report.main([obs_dir, "--fleet"]) == 0
+    out = capsys.readouterr().out
+    assert "PARTIAL TRACES" in out and "rep1" in out
+
+
+def test_fleet_chrome_trace_one_track_per_process(tmp_path):
+    """The merged fleet records export as a Chrome trace with one pid
+    (track) per recording process — the Perfetto view of one request's
+    cross-process life."""
+    from tpudl.obs.spans import chrome_trace_events
+
+    obs_dir = _write_fleet_streams(tmp_path)
+    records = obs_report.load_records([obs_dir])
+    events = chrome_trace_events(records)
+    names = {
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert len(names) == 2
+    assert any("router-host" in n for n in names)
+    assert any("rep1-host" in n for n in names)
+
+
+def test_dead_member_stale_burn_is_not_pressure():
+    """Review regression: a member whose LAST GOOD snapshot showed a
+    burning SLO then became unreachable must read as unhealthy —
+    NOT as still-burning, or a crashed replica would feed the
+    autoscaler permanent pressure and pin the fleet at max_replicas."""
+    state = {"alive": True}
+
+    def snapshot():
+        if not state["alive"]:
+            raise ConnectionError("member gone")
+        return {
+            "registry": {},
+            "health": {
+                "healthy": False,
+                "sources": {
+                    "slo": {"healthy": False, "burning": ["ttft_p99"]},
+                },
+            },
+        }
+
+    fleet = FleetMonitor({"m": snapshot}, scrape_interval_s=0.0)
+    assert fleet.burning_sources() == ["m"]  # alive and burning
+    state["alive"] = False
+    fleet.scrape(force=True)
+    snap = fleet.fleet_snapshot()
+    assert snap["burning_sources"] == []  # stale burn is not a burn
+    assert snap["sources"]["m"]["ok"] is False
+    assert snap["sources"]["m"]["healthy"] is False
+    assert snap["healthy"] is False  # still a sick fleet, just not burning
+
+
+def test_replica_inbox_shed_trace_is_not_partial(tmp_path):
+    """Review regression: a request shed AT THE REPLICA INBOX leaves
+    routed + replica_dequeue + (replica-recorded) completion — its
+    dequeue record proves the hop's stream IS in the merge, so the
+    stitch must not claim spans are missing from disk."""
+    rec = SpanRecorder(
+        str(tmp_path / "spans-h-p0-1.jsonl"), host="h", process=0
+    )
+    rec.event("request_routed", "serve_request", request_id="late",
+              replica="r0", priority=0)
+    rec.event("replica_dequeue", "serve_request", request_id="late",
+              replica="r0", inbox_wait_s=2.0)
+    rec.event("request_complete", "serve_request", request_id="late",
+              finish_reason="shed_timeout", queue_wait_s=2.0,
+              num_tokens=0, shed_by="replica_inbox")
+    rec.close()
+    records = obs_report.load_records([str(tmp_path)])
+    tl = obs_report.build_request_timeline(records, "late")
+    assert tl["warnings"] == []
+    assert tl["finish_reason"] == "shed_timeout"
+    # And even WITHOUT the completion record (shed mid-flight), the
+    # dequeue alone proves the hop stream is present: only the
+    # "no completion" warning may fire, never "no spans on disk".
+    tl2 = obs_report.build_request_timeline(records[:2], "late")
+    assert len(tl2["warnings"]) == 1
+    assert "no completion event" in tl2["warnings"][0]

@@ -254,3 +254,65 @@ def test_shed_timeline_is_single_completion(model_and_params, tmp_path):
     assert tl["found"]["prefill"] is False
     assert tl["found"]["decode_chunks"] == 0
     assert [e["what"] for e in tl["timeline"]] == ["queued", "complete"]
+
+
+def test_router_trace_hops_and_router_ttft_decomposition(
+    model_and_params, tmp_path
+):
+    """A LIVE two-replica router run records the fleet-trace hops
+    (router door -> replica inbox -> admission -> prefill -> decode ->
+    served -> complete) and the stitched decomposition sums to the
+    router-measured TTFT: inbox_wait + queue_wait + prefill ==
+    router_ttft, every term a measured duration."""
+    from tpudl.serve import Replica, Router
+
+    model, params = model_and_params
+    obs.enable(str(tmp_path / "obs"))
+    replicas = [
+        Replica(
+            f"rep{i}",
+            ServeSession.from_model(
+                model, params, prompt_len=PROMPT_LEN, num_slots=2
+            ),
+        )
+        for i in range(2)
+    ]
+    rng = np.random.default_rng(2)
+    requests = [
+        Request(
+            f"r{i}",
+            rng.integers(1, 500, size=4).tolist(),
+            max_new_tokens=int(rng.integers(3, 8)),
+        )
+        for i in range(5)
+    ]
+    with Router(replicas) as router:
+        results = router.serve(requests, timeout_s=300.0)
+    records = obs_spans.active_recorder().records
+    obs.disable()
+    assert all(res.ok for res in results.values())
+    for rid, res in results.items():
+        tl = obs_report.build_request_timeline(records, rid)
+        assert tl["warnings"] == []
+        assert tl["hops"]["routed"] is True
+        assert tl["hops"]["replica"] in {"rep0", "rep1"}
+        whats = [e["what"] for e in tl["timeline"]]
+        assert whats[0] == "routed"
+        assert "replica_dequeue" in whats and "served" in whats
+        assert whats[-1] == "complete"
+        d = tl["decomposition"]
+        assert d["inbox_wait_s"] is not None
+        assert d["router_ttft_s"] == pytest.approx(
+            res.ttft_s + d["inbox_wait_s"], rel=1e-6
+        )
+        # The fleet acceptance identity, on real measurements.
+        assert (
+            d["inbox_wait_s"] + d["queue_wait_s"] + d["prefill_s"]
+            == pytest.approx(d["router_ttft_s"], rel=1e-6)
+        )
+    # The same records render as a fleet report with every request
+    # fully stitched.
+    fleet = obs_report.build_fleet_report(records)
+    assert fleet["num_requests"] == 5
+    assert fleet["partial_traces"] == {}
+    assert fleet["router_ttft"]["count"] == 5

@@ -30,9 +30,12 @@ On top of the post-mortem stream sits the LIVE plane
 (``tpudl.obs.exporter``, enabled via ``TPUDL_OBS_PORT``): a stdlib
 HTTP server exposing ``/metrics`` (Prometheus text from the registry),
 ``/healthz`` (heartbeats + component health sources, probe-compatible
-200/503), and ``/snapshot`` (registry + live goodput) while the
-process runs — and ``tpudl.obs.slo`` evaluates declarative latency
-objectives with burn-rate alerting over it.
+200/503), and ``/snapshot`` (registry + live goodput + the active span
+-stream path) while the process runs — ``tpudl.obs.slo`` evaluates
+declarative latency objectives with burn-rate alerting over it, and
+``tpudl.obs.fleet`` aggregates N such processes into one labeled
+fleet view (merged ``/metrics``, health rollup, cross-process trace
+stitching) for the serve tier's autoscaler.
 """
 
 from tpudl.obs.counters import (  # noqa: F401
@@ -46,6 +49,7 @@ from tpudl.obs.exporter import (  # noqa: F401
     Heartbeat,
     ObsExporter,
     active_exporter,
+    format_labels,
     health_snapshot,
     register_health_source,
     render_prometheus,
@@ -53,14 +57,20 @@ from tpudl.obs.exporter import (  # noqa: F401
     stop_exporter,
     unregister_health_source,
 )
+from tpudl.obs.fleet import (  # noqa: F401
+    FleetMonitor,
+    render_fleet_prometheus,
+)
 from tpudl.obs.goodput import (  # noqa: F401
     classify,
     classify_by_process,
     format_goodput,
 )
 from tpudl.obs.report import (  # noqa: F401
+    build_fleet_report,
     build_report,
     build_request_timeline,
+    format_fleet_report,
     format_report,
     format_request_timeline,
     load_records,

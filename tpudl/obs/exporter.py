@@ -227,6 +227,7 @@ def _reset_health_for_tests() -> None:
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_NAME_OK = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
 
 #: Exact-percentile quantiles rendered per histogram (the keys
 #: Registry.snapshot already computes).
@@ -249,35 +250,74 @@ def _fmt(v: float) -> str:
     return repr(v)
 
 
+def _label_value(v) -> str:
+    """Escape one label value per the exposition format (backslash,
+    quote, newline)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def format_labels(labels: Optional[Dict[str, str]]) -> str:
+    """``{"source": "replica1"}`` -> ``{source="replica1"}`` (empty
+    string for no labels). Label NAMES must already be exposition-legal
+    — they come from code, not data, so a bad one is a caller bug and
+    raises rather than being silently mangled into the metric name."""
+    if not labels:
+        return ""
+    for k in labels:
+        if not _LABEL_NAME_OK.match(k):
+            raise ValueError(f"illegal Prometheus label name {k!r}")
+    inner = ",".join(
+        f'{k}="{_label_value(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
 def render_prometheus(
-    snapshot: dict, heartbeats: Optional[Dict[str, float]] = None
+    snapshot: dict,
+    heartbeats: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
 ) -> str:
     """A ``Registry.snapshot()`` dict -> Prometheus text exposition
     (version 0.0.4). Counters and gauges render verbatim; histograms as
     summaries: cumulative ``_count``/``_sum`` plus exact-quantile rows
     over the bounded window. ``heartbeats`` (name -> age seconds, see
-    ``heartbeat_ages``) ride along as gauges."""
+    ``heartbeat_ages``) ride along as gauges.
+
+    ``labels`` attaches the same label set to every series (the fleet
+    view's ``{source="replica1"}``) instead of mangling provenance into
+    metric names; histogram quantile rows merge it with their
+    ``quantile`` label. ``labels=None`` output is byte-identical to the
+    pre-label renderer (regression-tested)."""
+    suffix = format_labels(labels)
     lines = []
     for name, value in sorted(snapshot.get("counters", {}).items()):
         m = _metric_name(name)
         lines.append(f"# TYPE {m} counter")
-        lines.append(f"{m} {_fmt(value)}")
+        lines.append(f"{m}{suffix} {_fmt(value)}")
     for name, value in sorted(snapshot.get("gauges", {}).items()):
         m = _metric_name(name)
         lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(value)}")
+        lines.append(f"{m}{suffix} {_fmt(value)}")
     for name, h in sorted(snapshot.get("histograms", {}).items()):
         m = _metric_name(name)
         lines.append(f"# TYPE {m} summary")
         if h.get("count"):
             for q, key in _QUANTILES:
-                lines.append(f'{m}{{quantile="{q}"}} {_fmt(h[key])}')
-        lines.append(f"{m}_sum {_fmt(h.get('sum', 0.0))}")
-        lines.append(f"{m}_count {int(h.get('count', 0))}")
+                qsuffix = format_labels(
+                    {**(labels or {}), "quantile": q}
+                )
+                lines.append(f"{m}{qsuffix} {_fmt(h[key])}")
+        lines.append(f"{m}_sum{suffix} {_fmt(h.get('sum', 0.0))}")
+        lines.append(f"{m}_count{suffix} {int(h.get('count', 0))}")
     for name, age in sorted((heartbeats or {}).items()):
         m = _metric_name(f"{name}_heartbeat_age_s")
         lines.append(f"# TYPE {m} gauge")
-        lines.append(f"{m} {_fmt(age)}")
+        lines.append(f"{m}{suffix} {_fmt(age)}")
     return "\n".join(lines) + "\n"
 
 
@@ -333,9 +373,17 @@ class ObsExporter:
             "registry": self._reg().snapshot(),
             "health": health_snapshot(),
             "goodput": None,
+            # Span-stream discovery for the fleet trace stitcher
+            # (tpudl.obs.fleet): when TPUDL_OBS_DIR is active this
+            # names the file the process is streaming spans into, so
+            # stitching needs no out-of-band path config.
+            "span_path": None,
         }
         rec = obs_spans.active_recorder()
         if rec is not None:
+            out["span_path"] = (
+                os.path.abspath(rec.path) if rec.path else None
+            )
             try:
                 from tpudl.obs import goodput as goodput_mod
 

@@ -1,0 +1,444 @@
+"""Fleet observability plane: cross-replica metric aggregation + trace
+stitching.
+
+Every telemetry surface before this one is per-process: PR 6's
+``ObsExporter`` serves ONE registry, the serve router scrapes replicas
+only for placement, and a request that hops router -> prefill worker ->
+decode replica scatters its spans across separate streams. This module
+is the fleet-level consumer:
+
+- **FleetMonitor** polls N exporter endpoints (``/snapshot`` over HTTP,
+  or an in-process ``ObsExporter.snapshot`` callable — the test seam
+  and the single-process router's path) and merges their registries
+  into one LABELED fleet view: ``serve_slots_busy{source="replica1"}``
+  instead of N mangled metric names. Its own ``/metrics`` endpoint
+  serves the merged exposition plus per-source scrape-age / failure /
+  up gauges, ``/fleet`` serves the JSON health rollup (per-source
+  healthy/burning/error with an overall verdict), and ``/healthz``
+  gives probes the 200/503 contract over that rollup.
+- **Trace stitching**: each member's ``/snapshot`` names its active
+  span-stream file (``span_path``, written when ``TPUDL_OBS_DIR`` is
+  set), so ``trace_records()`` discovers and merges every member's
+  JSONL stream with no out-of-band config — the records
+  ``report.py --fleet`` / ``--request`` stitch into one
+  router-door -> queue -> prefill -> inbox -> decode timeline, and
+  ``chrome_trace_events`` renders with one track per process.
+
+Clock discipline: member span streams use per-process MONOTONIC clocks,
+so the stitcher never subtracts timestamps across streams — hop
+decomposition sums DURATIONS, each measured by the process that owned
+the hop (see tpudl.obs.report.build_request_timeline).
+
+Stdlib-only, thread-safe, injectable clock, like the rest of tpudl.obs.
+Scrapes are time-gated on access (``scrape_interval_s``) so a scrape
+storm against ``/metrics`` does not turn into a scrape storm against
+every member.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Union
+
+from tpudl.obs.exporter import _QUANTILES, _fmt, _metric_name, format_labels
+from tpudl.obs.spans import read_jsonl
+
+#: A source: the member's /snapshot URL, or a zero-arg callable
+#: returning the same payload in-process (ObsExporter.snapshot).
+Source = Union[str, Callable[[], dict]]
+
+
+def render_fleet_prometheus(
+    snapshots: Dict[str, dict],
+    extra_gauges: Optional[Dict[str, Dict[Optional[str], float]]] = None,
+) -> str:
+    """Merge per-source ``Registry.snapshot()`` dicts into ONE valid
+    Prometheus exposition: each metric's ``# TYPE`` line appears once,
+    followed by one series per source labeled ``{source="..."}`` —
+    the grouping the exposition format requires (concatenating N
+    single-source renders would repeat TYPE lines per metric).
+
+    ``extra_gauges`` adds fleet-level gauges: ``{metric: {source:
+    value}}`` where a ``None`` source key renders an unlabeled
+    (fleet-scoped) series."""
+    counters: Dict[str, Dict[str, float]] = {}
+    gauges: Dict[str, Dict[str, float]] = {}
+    histograms: Dict[str, Dict[str, dict]] = {}
+    for source in sorted(snapshots):
+        snap = snapshots[source] or {}
+        for name, v in snap.get("counters", {}).items():
+            counters.setdefault(name, {})[source] = v
+        for name, v in snap.get("gauges", {}).items():
+            gauges.setdefault(name, {})[source] = v
+        for name, h in snap.get("histograms", {}).items():
+            histograms.setdefault(name, {})[source] = h
+    lines: List[str] = []
+    for name in sorted(counters):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} counter")
+        for source in sorted(counters[name]):
+            suffix = format_labels({"source": source})
+            lines.append(f"{m}{suffix} {_fmt(counters[name][source])}")
+    for name in sorted(gauges):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        for source in sorted(gauges[name]):
+            suffix = format_labels({"source": source})
+            lines.append(f"{m}{suffix} {_fmt(gauges[name][source])}")
+    for name in sorted(histograms):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} summary")
+        for source in sorted(histograms[name]):
+            h = histograms[name][source]
+            if h.get("count"):
+                for q, key in _QUANTILES:
+                    qsuffix = format_labels(
+                        {"source": source, "quantile": q}
+                    )
+                    lines.append(f"{m}{qsuffix} {_fmt(h[key])}")
+            suffix = format_labels({"source": source})
+            lines.append(f"{m}_sum{suffix} {_fmt(h.get('sum', 0.0))}")
+            lines.append(f"{m}_count{suffix} {int(h.get('count', 0))}")
+    for name in sorted(extra_gauges or {}):
+        m = _metric_name(name)
+        lines.append(f"# TYPE {m} gauge")
+        for source in sorted(
+            (extra_gauges or {})[name], key=lambda s: (s is not None, s)
+        ):
+            suffix = (
+                format_labels({"source": source})
+                if source is not None else ""
+            )
+            value = (extra_gauges or {})[name][source]
+            lines.append(f"{m}{suffix} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def _burning_names(health: dict) -> List[str]:
+    """Every burning objective named anywhere in a member's health
+    report (the SloMonitor health source's ``burning`` list; the serve
+    router's ``burning_replicas`` ride along too)."""
+    out: List[str] = []
+    for src in (health or {}).get("sources", {}).values():
+        if not isinstance(src, dict):
+            continue
+        for key in ("burning", "burning_replicas"):
+            names = src.get(key)
+            if isinstance(names, (list, tuple)):
+                out.extend(str(n) for n in names)
+    return sorted(set(out))
+
+
+class FleetMonitor:
+    """Poll N member ``/snapshot`` endpoints; serve the merged view.
+
+    ``sources`` maps member name -> ``/snapshot`` URL (or any URL whose
+    GET returns the snapshot JSON) or an in-process callable returning
+    the same payload. A member that fails to scrape keeps its LAST GOOD
+    registry in the merged ``/metrics`` (stale data is visible through
+    its ``fleet_scrape_age_s`` gauge, absent data is not) but reads as
+    unhealthy in the rollup until a scrape succeeds again."""
+
+    def __init__(
+        self,
+        sources: Dict[str, Source],
+        scrape_interval_s: float = 0.5,
+        scrape_timeout_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not sources:
+            raise ValueError("FleetMonitor needs at least one source")
+        self.sources: Dict[str, Source] = dict(sources)
+        self.scrape_interval_s = scrape_interval_s
+        self.scrape_timeout_s = scrape_timeout_s
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._state: Dict[str, dict] = {
+            name: {
+                "ok": False,
+                "snapshot": None,
+                "last_ok_at": None,
+                "failures": 0,
+                "error": "never scraped",
+            }
+            for name in self.sources
+        }
+        self._last_scrape = float("-inf")
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership (the autoscaler adds/removes replicas live) --------
+
+    def add_source(self, name: str, source: Source) -> None:
+        with self._lock:
+            self.sources[name] = source
+            self._state[name] = {
+                "ok": False, "snapshot": None, "last_ok_at": None,
+                "failures": 0, "error": "never scraped",
+            }
+
+    def remove_source(self, name: str) -> None:
+        with self._lock:
+            self.sources.pop(name, None)
+            self._state.pop(name, None)
+
+    # -- scraping ------------------------------------------------------
+
+    def _scrape_one(self, source: Source) -> dict:
+        if callable(source):
+            return dict(source())
+        with urllib.request.urlopen(
+            source, timeout=self.scrape_timeout_s
+        ) as resp:
+            return json.loads(resp.read().decode())
+
+    def scrape(self, force: bool = True) -> None:
+        """Scrape every member (time-gated unless ``force``). A failed
+        member records the error and bumps its failure counter; its
+        last good snapshot is retained."""
+        now = self.clock()
+        with self._lock:
+            if not force and now - self._last_scrape < self.scrape_interval_s:
+                return
+            self._last_scrape = now
+            sources = dict(self.sources)
+        for name, source in sources.items():
+            try:
+                snap = self._scrape_one(source)
+                err = None
+            except Exception as e:
+                snap = None
+                err = f"{type(e).__name__}: {e}"
+            with self._lock:
+                st = self._state.get(name)
+                if st is None:  # removed mid-scrape
+                    continue
+                if err is None:
+                    st["ok"] = True
+                    st["snapshot"] = snap
+                    st["last_ok_at"] = self.clock()
+                    st["error"] = None
+                else:
+                    st["ok"] = False
+                    st["failures"] += 1
+                    st["error"] = err
+
+    # -- the merged views ----------------------------------------------
+
+    def snapshots(self) -> Dict[str, Optional[dict]]:
+        """Last good full /snapshot payload per member (None until one
+        lands)."""
+        with self._lock:
+            return {
+                name: st["snapshot"] for name, st in self._state.items()
+            }
+
+    def fleet_snapshot(self) -> dict:
+        """The health rollup ``/fleet`` serves: per-member scrape state
+        + health verdict + burning objectives, and the fleet-level
+        ``healthy`` AND (the k8s-probe contract: one sick member is a
+        sick fleet)."""
+        self.scrape(force=False)
+        now = self.clock()
+        with self._lock:
+            states = {n: dict(st) for n, st in self._state.items()}
+        sources: dict = {}
+        healthy = True
+        burning_sources: List[str] = []
+        for name in sorted(states):
+            st = states[name]
+            snap = st["snapshot"] or {}
+            health = snap.get("health") or {}
+            member_healthy = bool(st["ok"]) and bool(
+                health.get("healthy", True)
+            )
+            # Burn state only counts from a member we can still REACH:
+            # a dead member's stale last-good snapshot must read as
+            # "unhealthy, unreachable", not as a burning SLO — the
+            # autoscaler treats burning as pressure, and a crashed
+            # replica must not pin the fleet at max_replicas forever.
+            burning = _burning_names(health) if st["ok"] else []
+            if burning:
+                burning_sources.append(name)
+            age = (
+                now - st["last_ok_at"]
+                if st["last_ok_at"] is not None else None
+            )
+            sources[name] = {
+                "ok": st["ok"],
+                "healthy": member_healthy,
+                "scrape_age_s": age,
+                "scrape_failures": st["failures"],
+                "error": st["error"],
+                "burning": burning,
+                "span_path": snap.get("span_path"),
+            }
+            healthy = healthy and member_healthy
+        return {
+            "sources": sources,
+            "sources_total": len(sources),
+            "sources_healthy": sum(
+                1 for s in sources.values() if s["healthy"]
+            ),
+            "burning_sources": burning_sources,
+            "healthy": healthy,
+        }
+
+    def burning_sources(self) -> List[str]:
+        """Members whose health report names a burning SLO objective —
+        the fleet-level scale-up signal."""
+        return self.fleet_snapshot()["burning_sources"]
+
+    def metrics_text(self) -> str:
+        """The merged labeled exposition: every member's registry under
+        ``{source="<name>"}`` plus the fleet's own per-source
+        scrape-age / failure / up gauges and the health rollup."""
+        fleet = self.fleet_snapshot()
+        with self._lock:
+            regs = {
+                name: (st["snapshot"] or {}).get("registry") or {}
+                for name, st in self._state.items()
+            }
+        extra: Dict[str, Dict[Optional[str], float]] = {
+            "fleet_sources_total": {None: fleet["sources_total"]},
+            "fleet_sources_healthy": {None: fleet["sources_healthy"]},
+            "fleet_healthy": {None: float(fleet["healthy"])},
+            "fleet_source_up": {},
+            "fleet_scrape_failures_total": {},
+            "fleet_scrape_age_s": {},
+        }
+        for name, src in fleet["sources"].items():
+            extra["fleet_source_up"][name] = float(src["ok"])
+            extra["fleet_scrape_failures_total"][name] = float(
+                src["scrape_failures"]
+            )
+            if src["scrape_age_s"] is not None:
+                extra["fleet_scrape_age_s"][name] = src["scrape_age_s"]
+        return render_fleet_prometheus(regs, extra_gauges=extra)
+
+    # -- trace stitching -----------------------------------------------
+
+    def trace_paths(self) -> Dict[str, str]:
+        """Each member's active span-stream file, discovered from its
+        ``/snapshot`` payload (satellite contract: no out-of-band
+        config). Members without recording active are absent."""
+        self.scrape(force=False)
+        out: Dict[str, str] = {}
+        with self._lock:
+            for name, st in self._state.items():
+                path = (st["snapshot"] or {}).get("span_path")
+                if path:
+                    out[name] = path
+        return out
+
+    def trace_records(
+        self, extra_paths: tuple = (), missing_ok: bool = True
+    ) -> List[dict]:
+        """Merge every discovered member span stream (plus
+        ``extra_paths`` files/dirs) into one record list — the input to
+        ``report.build_request_timeline`` / ``build_fleet_report``. A
+        discovered path that does not exist on THIS host (a truly
+        remote member) is skipped when ``missing_ok``."""
+        from tpudl.obs.report import load_records
+
+        records: List[dict] = []
+        for path in sorted(set(self.trace_paths().values())):
+            if not os.path.exists(path):
+                if missing_ok:
+                    continue
+                raise FileNotFoundError(path)
+            records.extend(read_jsonl(path))
+        if extra_paths:
+            records.extend(load_records(list(extra_paths)))
+        return records
+
+    # -- the HTTP server -----------------------------------------------
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> "FleetMonitor":
+        """Serve ``/metrics`` (merged labeled exposition), ``/fleet``
+        (JSON rollup), and ``/healthz`` (200/503 over the rollup).
+        Loopback by default — the endpoints are unauthenticated."""
+        if self._server is not None:
+            return self
+        monitor = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(
+                            200,
+                            monitor.metrics_text().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/fleet":
+                        self._send(
+                            200,
+                            json.dumps(monitor.fleet_snapshot()).encode(),
+                            "application/json",
+                        )
+                    elif path == "/healthz":
+                        fleet = monitor.fleet_snapshot()
+                        self._send(
+                            200 if fleet["healthy"] else 503,
+                            json.dumps(fleet).encode(),
+                            "application/json",
+                        )
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except Exception as e:  # never kill the server thread
+                    try:
+                        self._send(
+                            500,
+                            f"{type(e).__name__}: {e}\n".encode(),
+                            "text/plain",
+                        )
+                    except OSError:
+                        pass
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpudl-fleet-monitor",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        if self._server is None:
+            return None
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "FleetMonitor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -227,13 +227,36 @@ def serve_request_breakdown(records: Iterable[dict]) -> dict:
 # ---------------------------------------------------------------------------
 
 
+#: Logical hop order of one served request's life — the sort key the
+#: stitched timeline uses FIRST, before timestamps: records from
+#: different processes carry unrelated monotonic clocks, so cross-
+#: stream ordering must come from the protocol, not the numbers.
+_HOP_RANK = {
+    "routed": 0, "failover": 1, "replica_dequeue": 2, "queued": 3,
+    "prefill": 4, "decode_chunk": 5, "served": 6, "complete": 7,
+}
+
+
 def build_request_timeline(records: Iterable[dict], request_id) -> dict:
     """Stitch one request's distributed trace out of a serve run's
-    records: the admission event, the prefill span carrying its
+    records — possibly MERGED from several processes' span streams (the
+    fleet case: router door events in the router's stream, admission /
+    prefill / decode spans in each replica's): the router-door
+    ``request_routed`` event, the replica-inbox ``replica_dequeue``
+    hop, the admission event, the prefill span carrying its
     ``request_id``, every decode chunk whose ``rids`` include it, and
     the completion event — plus the TTFT/generation decomposition
     (queue-wait / prefill / first-decode-chunk / decode total) checked
-    against the completion event's measured aggregates.
+    against the completion event's measured aggregates, and the
+    router-level decomposition (inbox wait + queue wait + prefill vs
+    the router-measured TTFT — all DURATIONS, so the sums survive
+    cross-process clock skew; timestamps are never compared across
+    streams).
+
+    A hop named in a router event whose records are absent (that
+    process's span stream not on disk) lands in ``warnings`` as a
+    "partial trace" — the merged directory is incomplete, not the
+    request unobserved.
 
     IDs are matched by string form too: a CLI ``--request 17`` finds an
     integer request_id 17."""
@@ -242,56 +265,176 @@ def build_request_timeline(records: Iterable[dict], request_id) -> dict:
     def _match(v) -> bool:
         return v == rid or str(v) == str(rid)
 
+    routed = None
+    dequeues: List[dict] = []
+    served_events: List[dict] = []
+    failovers: List[dict] = []
     queued = None
-    prefill = None
+    prefills: List[dict] = []
     decode_chunks: List[dict] = []
     complete = None
     for r in records:
         kind = r.get("kind")
         if kind == "event" and _match(r.get("request_id")):
-            if r.get("name") == "request_queued":
+            name = r.get("name")
+            if name == "request_queued":
                 queued = r
-            elif r.get("name") == "request_complete":
+            elif name == "request_complete":
                 complete = r
+            elif name == "request_routed":
+                routed = r
+            elif name == "replica_dequeue":
+                dequeues.append(r)
+            elif name == "request_served":
+                served_events.append(r)
+            elif name == "request_failover":
+                failovers.append(r)
         elif kind == "span":
             if _match(r.get("request_id")):
-                prefill = r
+                prefills.append(r)
             elif any(_match(x) for x in (r.get("rids") or ())):
                 decode_chunks.append(r)
-    if queued is None and prefill is None and complete is None:
+    if (
+        queued is None and not prefills and complete is None
+        and routed is None and not dequeues and not served_events
+    ):
         raise KeyError(
             f"no trace records carry request_id {request_id!r} — was the "
             f"serve run recorded with TPUDL_OBS_DIR set?"
         )
+
+    # A failed-over request leaves records from BOTH attempts; the
+    # completing process's are authoritative (the restarted copy). Key
+    # by recording process and prefer its records when the streams
+    # disagree — within one stream, "latest wins" is safe (same clock).
+    proc_key = (
+        goodput_mod.process_key(complete) if complete is not None else None
+    )
+
+    def _prefer_proc(cands: List[dict]) -> Optional[dict]:
+        if not cands:
+            return None
+        if proc_key is not None:
+            same = [
+                c for c in cands
+                if goodput_mod.process_key(c) == proc_key
+            ]
+            if same:
+                return max(same, key=lambda s: float(s["ts"]))
+        return max(cands, key=lambda s: float(s["ts"]))
+
+    prefill = _prefer_proc(prefills)
+    if proc_key is not None:
+        same_chunks = [
+            c for c in decode_chunks
+            if goodput_mod.process_key(c) == proc_key
+        ]
+        if same_chunks:
+            decode_chunks = same_chunks
     decode_chunks.sort(key=lambda s: float(s["ts"]))
+    dequeue = _prefer_proc(dequeues)
+    served = _prefer_proc(served_events)
+
+    warnings: List[str] = []
+    # Any record beyond the router's own door event proves the routed
+    # hop's stream made it into the merge — a replica_dequeue with no
+    # engine records is a replica-side shed, not a missing stream.
+    engine_side = bool(
+        queued or prefill or decode_chunks or complete
+        or dequeues or served_events
+    )
+    if routed is not None and not engine_side:
+        if routed.get("replica"):
+            kind, hop = "replica", routed["replica"]
+        elif routed.get("worker"):
+            kind, hop = "prefill worker", routed["worker"]
+        else:
+            kind, hop = "hop", "?"
+        warnings.append(
+            f"partial trace: request {request_id!r} was routed to "
+            f"{kind} {hop!r} but no spans from that hop are on disk — "
+            f"merge that process's span stream (TPUDL_OBS_DIR) into "
+            f"this report"
+        )
+    if complete is None and (routed is not None or queued is not None):
+        warnings.append(
+            f"partial trace: no completion event for {request_id!r} — "
+            f"the request is still in flight, or the completing "
+            f"process's stream is missing"
+        )
 
     timeline: List[dict] = []
+    if routed is not None:
+        timeline.append({
+            "ts": float(routed["ts"]), "dur": 0.0, "what": "routed",
+            "detail": {"replica": routed.get("replica"),
+                       "worker": routed.get("worker"),
+                       "priority": routed.get("priority")},
+            "record": routed,
+        })
+    for f in failovers:
+        timeline.append({
+            "ts": float(f["ts"]), "dur": 0.0, "what": "failover",
+            "detail": {"from_replica": f.get("from_replica")},
+            "record": f,
+        })
+    if dequeue is not None:
+        timeline.append({
+            "ts": float(dequeue["ts"]),
+            "dur": float(dequeue.get("inbox_wait_s") or 0.0),
+            "what": "replica_dequeue",
+            "detail": {"replica": dequeue.get("replica"),
+                       "inbox_wait_s": dequeue.get("inbox_wait_s")},
+            "record": dequeue,
+        })
     if queued is not None:
         timeline.append({
             "ts": float(queued["ts"]), "dur": 0.0, "what": "queued",
             "detail": {"priority": queued.get("req_priority"),
                        "deadline_s": queued.get("deadline_s"),
                        "depth": queued.get("depth")},
+            "record": queued,
         })
     if prefill is not None:
         timeline.append({
             "ts": float(prefill["ts"]), "dur": float(prefill["dur"]),
             "what": "prefill",
-            "detail": {"slot": prefill.get("slot")},
+            "detail": {"slot": prefill.get("slot"),
+                       "worker": prefill.get("worker")},
+            "record": prefill,
         })
     for i, c in enumerate(decode_chunks):
         timeline.append({
             "ts": float(c["ts"]), "dur": float(c["dur"]),
             "what": "decode_chunk",
             "detail": {"index": i, "busy": c.get("busy")},
+            "record": c,
+        })
+    if served is not None:
+        timeline.append({
+            "ts": float(served["ts"]), "dur": 0.0, "what": "served",
+            "detail": {"replica": served.get("replica"),
+                       "router_ttft_s": served.get("router_ttft_s")},
+            "record": served,
         })
     if complete is not None:
         timeline.append({
             "ts": float(complete["ts"]), "dur": 0.0, "what": "complete",
             "detail": {"finish_reason": complete.get("finish_reason"),
                        "num_tokens": complete.get("num_tokens")},
+            "record": complete,
         })
-    timeline.sort(key=lambda e: e["ts"])
+    # Logical hop order first, timestamps only within it: records from
+    # different processes carry unrelated monotonic clocks.
+    timeline.sort(key=lambda e: (_HOP_RANK.get(e["what"], 99), e["ts"]))
+    # Tag each entry with its recording process (rendered when the
+    # stitched trace spans more than one stream) and drop the raw
+    # record from the output.
+    proc_keys = {goodput_mod.process_key(e["record"]) for e in timeline}
+    labels = goodput_mod.process_labels(proc_keys)
+    for e in timeline:
+        e["process"] = labels[goodput_mod.process_key(e.pop("record"))]
+    multi_process = len(proc_keys) > 1
 
     # Decomposition. Queue wait prefers the completion event's measured
     # value (exact), falling back to prefill-start minus queued-event
@@ -317,6 +460,30 @@ def build_request_timeline(records: Iterable[dict], request_id) -> dict:
         generation_s = complete.get("generation_s")
         if ttft_s is not None:
             measured_s = float(ttft_s) + float(generation_s or 0.0)
+
+    # Router-level decomposition (fleet runs): the replica-inbox hop
+    # plus the engine-measured TTFT is the router-door -> first-token
+    # time. Both sides are duration sums, so the identity holds across
+    # processes with unrelated clocks:
+    #   inbox_wait + queue_wait + prefill  ==  router_ttft
+    # (== inbox_wait + ttft, since queue_wait + prefill == ttft by the
+    # engine's own timestamps).
+    inbox_wait_s = None
+    if dequeue is not None and dequeue.get("inbox_wait_s") is not None:
+        inbox_wait_s = float(dequeue["inbox_wait_s"])
+    elif served is not None and served.get("inbox_wait_s") is not None:
+        inbox_wait_s = float(served["inbox_wait_s"])
+    router_ttft_s = None
+    if served is not None and served.get("router_ttft_s") is not None:
+        router_ttft_s = float(served["router_ttft_s"])
+    elif ttft_s is not None:
+        router_ttft_s = float(ttft_s) + (inbox_wait_s or 0.0)
+    router_accounted_s = None
+    if queue_wait_s is not None or prefill_s is not None:
+        router_accounted_s = sum(
+            v for v in (inbox_wait_s, queue_wait_s, prefill_s)
+            if v is not None
+        )
     return {
         "request_id": request_id,
         "found": {
@@ -325,6 +492,18 @@ def build_request_timeline(records: Iterable[dict], request_id) -> dict:
             "decode_chunks": len(decode_chunks),
             "complete": complete is not None,
         },
+        "hops": {
+            "routed": routed is not None,
+            "replica": (
+                (served or dequeue or {}).get("replica")
+                or (routed or {}).get("replica")
+            ),
+            "worker": (prefill or routed or {}).get("worker"),
+            "failovers": len(failovers),
+            "processes": sorted(labels.values()),
+            "multi_process": multi_process,
+        },
+        "warnings": warnings,
         "finish_reason": (
             complete.get("finish_reason") if complete is not None else None
         ),
@@ -333,12 +512,15 @@ def build_request_timeline(records: Iterable[dict], request_id) -> dict:
         ),
         "timeline": timeline,
         "decomposition": {
+            "inbox_wait_s": inbox_wait_s,
             "queue_wait_s": queue_wait_s,
             "prefill_s": prefill_s,
             "first_decode_chunk_s": first_chunk_s,
             "decode_s": decode_s,
             "accounted_s": accounted_s,
+            "router_accounted_s": router_accounted_s,
             "measured_ttft_s": ttft_s,
+            "router_ttft_s": router_ttft_s,
             "measured_generation_s": generation_s,
             "measured_total_s": measured_s,
             # Host bookkeeping between chunks is real wall-clock the
@@ -353,43 +535,242 @@ def build_request_timeline(records: Iterable[dict], request_id) -> dict:
 
 
 def format_request_timeline(tl: dict) -> str:
-    """Human rendering of ``build_request_timeline``."""
+    """Human rendering of ``build_request_timeline``. In a stitched
+    multi-process trace, ``t_ms`` is relative to the FIRST entry of
+    the SAME process's stream (cross-stream timestamps are on
+    unrelated monotonic clocks and are never subtracted); the process
+    column names the stream each hop came from."""
 
     def ms(v):
         return f"{1e3 * v:9.3f}" if v is not None else "        —"
 
+    hops = tl.get("hops", {})
+    multi = bool(hops.get("multi_process"))
     lines = [
         f"request {tl['request_id']} — "
         f"finish_reason={tl['finish_reason']} "
         f"tokens={tl['num_tokens']}",
-        "",
-        f"{'t_ms':>10} {'dur_ms':>9}  event",
     ]
-    t0 = tl["timeline"][0]["ts"] if tl["timeline"] else 0.0
+    for w in tl.get("warnings", ()):
+        lines.append(f"WARNING: {w}")
+    lines += [
+        "",
+        f"{'t_ms':>10} {'dur_ms':>9}  event"
+        + ("  (t_ms per-process)" if multi else ""),
+    ]
+    proc_t0: Dict[str, float] = {}
+    for e in tl["timeline"]:
+        proc_t0.setdefault(e.get("process", "?"), e["ts"])
     for e in tl["timeline"]:
         detail = " ".join(
             f"{k}={v}" for k, v in e["detail"].items() if v is not None
         )
+        proc = e.get("process", "?")
+        tag = f" @{proc}" if multi else ""
         lines.append(
-            f"{1e3 * (e['ts'] - t0):10.3f} {1e3 * e['dur']:9.3f}  "
-            f"{e['what']}{'  [' + detail + ']' if detail else ''}"
+            f"{1e3 * (e['ts'] - proc_t0[proc]):10.3f} "
+            f"{1e3 * e['dur']:9.3f}  "
+            f"{e['what']}{'  [' + detail + ']' if detail else ''}{tag}"
         )
     d = tl["decomposition"]
     lines += [
         "",
         "TTFT/generation decomposition (ms):",
+    ]
+    if d.get("inbox_wait_s") is not None:
+        lines.append(f"  replica_inbox_wait {ms(d['inbox_wait_s'])}")
+    lines += [
         f"  queue_wait         {ms(d['queue_wait_s'])}",
         f"  prefill            {ms(d['prefill_s'])}",
         f"  first_decode_chunk {ms(d['first_decode_chunk_s'])}",
         f"  decode total       {ms(d['decode_s'])}",
         f"  accounted          {ms(d['accounted_s'])}",
         f"  measured ttft      {ms(d['measured_ttft_s'])}",
+    ]
+    if d.get("router_ttft_s") is not None:
+        lines.append(
+            f"  router ttft        {ms(d['router_ttft_s'])}"
+            + (
+                f"  (hops sum {ms(d['router_accounted_s']).strip()})"
+                if d.get("router_accounted_s") is not None else ""
+            )
+        )
+    lines.append(
         f"  measured total     {ms(d['measured_total_s'])}"
         + (
             f"  (coverage {d['coverage']:.3f})"
             if d["coverage"] is not None else ""
         ),
+    )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Fleet mode (--fleet): the cross-replica view over merged streams
+# ---------------------------------------------------------------------------
+
+
+def build_fleet_report(records: List[dict]) -> dict:
+    """The fleet-level rollup over records MERGED from every member's
+    span stream (tpudl.obs.fleet.FleetMonitor.trace_records, or just
+    ``report.py --fleet dir1 dir2 ...``): per-process record counts,
+    the serve-request outcome breakdown, router hop-latency
+    distributions (inbox wait, router-level TTFT — duration sums, clock
+    -skew free), failover/membership/autoscale activity, and every
+    request whose stitched trace is PARTIAL (a hop's stream missing
+    from the merge)."""
+    per_proc: Dict[tuple, dict] = {}
+    rids: List = []
+    seen_rids = set()
+    membership: List[dict] = []
+    autoscale_actions: List[dict] = []
+    for r in records:
+        key = goodput_mod.process_key(r)
+        row = per_proc.setdefault(key, {"records": 0, "spans": 0,
+                                        "events": 0})
+        row["records"] += 1
+        kind = r.get("kind")
+        if kind == "span":
+            row["spans"] += 1
+        elif kind == "event":
+            row["events"] += 1
+            name = r.get("name")
+            if name in (
+                "request_routed", "request_served", "request_complete",
+            ):
+                rid = r.get("request_id")
+                marker = str(rid)
+                if marker not in seen_rids:
+                    seen_rids.add(marker)
+                    rids.append(rid)
+            elif name in ("replica_added", "replica_removed"):
+                membership.append({
+                    "what": name, "replica": r.get("replica"),
+                    "drained": r.get("drained"),
+                })
+            elif name == "autoscale":
+                autoscale_actions.append({
+                    "action": r.get("action"),
+                    "replica": r.get("replica"),
+                    "reason": r.get("reason"),
+                })
+    labels = goodput_mod.process_labels(per_proc)
+    processes = {
+        labels[k]: per_proc[k]
+        for k in sorted(per_proc, key=lambda k: labels[k])
+    }
+
+    # Bucket records per request ONCE (string-keyed, matching the
+    # stitcher's id coercion): stitching each request from its own
+    # bucket keeps the report linear in the record count instead of
+    # O(requests x records) full rescans.
+    buckets: Dict[str, List[dict]] = {}
+    for r in records:
+        keys = set()
+        if r.get("request_id") is not None:
+            keys.add(str(r["request_id"]))
+        for x in r.get("rids") or ():
+            keys.add(str(x))
+        for k in keys:
+            buckets.setdefault(k, []).append(r)
+
+    router_ttfts: List[float] = []
+    inbox_waits: List[float] = []
+    failovers = 0
+    partial: Dict[str, List[str]] = {}
+    for rid in rids:
+        try:
+            tl = build_request_timeline(buckets.get(str(rid), []), rid)
+        except KeyError:
+            partial[str(rid)] = ["no stitchable records"]
+            continue
+        d = tl["decomposition"]
+        if d.get("router_ttft_s") is not None:
+            router_ttfts.append(float(d["router_ttft_s"]))
+        if d.get("inbox_wait_s") is not None:
+            inbox_waits.append(float(d["inbox_wait_s"]))
+        failovers += tl["hops"]["failovers"]
+        if tl["warnings"]:
+            partial[str(rid)] = list(tl["warnings"])
+    return {
+        "num_records": len(records),
+        "processes": processes,
+        "num_requests": len(rids),
+        "serve_requests": serve_request_breakdown(records),
+        "router_ttft": _dist(router_ttfts) if router_ttfts else None,
+        "replica_inbox_wait": _dist(inbox_waits) if inbox_waits else None,
+        "failovers": failovers,
+        "membership": membership,
+        "autoscale_actions": autoscale_actions,
+        "partial_traces": partial,
+    }
+
+
+def format_fleet_report(report: dict) -> str:
+    """Human rendering of ``build_fleet_report``."""
+    lines = [
+        f"tpudl fleet report — {report['num_records']} records from "
+        f"{len(report['processes'])} process stream(s), "
+        f"{report['num_requests']} request(s)",
+        "",
+        f"{'process':24} {'records':>8} {'spans':>7} {'events':>7}",
     ]
+    for label, row in report["processes"].items():
+        lines.append(
+            f"{label:24} {row['records']:8d} {row['spans']:7d} "
+            f"{row['events']:7d}"
+        )
+    if report.get("serve_requests"):
+        lines += [
+            "",
+            f"{'serve requests':16} {'count':>6} {'tokens':>8} "
+            f"{'q_wait_ms':>10} {'ttft_ms':>9}",
+        ]
+        for reason, r in report["serve_requests"].items():
+            qw = (
+                f"{r['mean_queue_wait_ms']:10.2f}"
+                if r["mean_queue_wait_ms"] is not None else f"{'—':>10}"
+            )
+            tt = (
+                f"{r['mean_ttft_ms']:9.2f}"
+                if r["mean_ttft_ms"] is not None else f"{'—':>9}"
+            )
+            lines.append(
+                f"{reason:16} {r['count']:6d} {r['tokens']:8d} {qw} {tt}"
+            )
+    for name, key in (
+        ("router TTFT", "router_ttft"),
+        ("replica inbox wait", "replica_inbox_wait"),
+    ):
+        d = report.get(key)
+        if d:
+            lines.append(
+                f"{name}: n={d['count']} mean={d['mean_ms']:.2f}ms "
+                f"p50={d['p50_ms']:.2f}ms p95={d['p95_ms']:.2f}ms "
+                f"p99={d['p99_ms']:.2f}ms"
+            )
+    if report["failovers"]:
+        lines.append(f"failovers: {report['failovers']}")
+    for m in report["membership"]:
+        drained = (
+            f" (drained={m['drained']})"
+            if m.get("drained") is not None else ""
+        )
+        lines.append(f"membership: {m['what']} {m['replica']}{drained}")
+    for a in report["autoscale_actions"]:
+        lines.append(
+            f"autoscale: {a['action']} {a['replica']} "
+            f"[reason: {a['reason']}]"
+        )
+    if report["partial_traces"]:
+        lines.append("")
+        lines.append(
+            f"PARTIAL TRACES ({len(report['partial_traces'])} "
+            f"request(s) with hops missing from the merge):"
+        )
+        for rid, warnings in sorted(report["partial_traces"].items()):
+            for w in warnings:
+                lines.append(f"  {rid}: {w}")
     return "\n".join(lines)
 
 
@@ -504,13 +885,30 @@ def main(argv: Optional[list] = None) -> int:
                     "JSON for Perfetto")
     ap.add_argument("--request", metavar="ID",
                     help="print ONE served request's stitched trace "
-                    "(admission -> prefill -> decode chunks -> "
-                    "completion) with its TTFT decomposition, instead "
-                    "of the run report")
+                    "(router door -> admission -> prefill -> decode "
+                    "chunks -> completion, merged across every span "
+                    "stream given) with its TTFT decomposition, "
+                    "instead of the run report")
+    ap.add_argument("--fleet", action="store_true",
+                    help="print the fleet rollup over the merged "
+                    "streams: per-process record counts, request "
+                    "outcomes, router hop latencies, failover/"
+                    "autoscale activity, and partial-trace warnings")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
     records = load_records(args.paths)
+    if args.fleet:
+        fleet = build_fleet_report(records)
+        if args.chrome_trace:
+            with open(args.chrome_trace, "w") as f:
+                json.dump(
+                    {"traceEvents": chrome_trace_events(records)}, f
+                )
+        print(
+            json.dumps(fleet) if args.json else format_fleet_report(fleet)
+        )
+        return 0
     if args.request is not None:
         try:
             tl = build_request_timeline(records, args.request)

@@ -10,9 +10,11 @@ layout and its paged + optionally int8-quantized successor
 requests onto the compiled XLA programs (tpudl.serve.engine), a
 synchronous Request/Result front end with token streaming that serves
 either a live model or a deserialized StableHLO artifact
-(tpudl.serve.api), and a load-balancing router over N engine replicas
+(tpudl.serve.api), a load-balancing router over N engine replicas
 with prefill/decode disaggregation and SLO-aware shedding
-(tpudl.serve.router).
+(tpudl.serve.router), and the SLO-driven autoscaler that grows and
+drains the replica fleet off the router's measured signals
+(tpudl.serve.autoscale).
 """
 
 from tpudl.serve.api import (  # noqa: F401
@@ -21,6 +23,10 @@ from tpudl.serve.api import (  # noqa: F401
     ServeSession,
     StreamChunk,
     assert_serving_parity,
+)
+from tpudl.serve.autoscale import (  # noqa: F401
+    AutoscaleConfig,
+    Autoscaler,
 )
 from tpudl.serve.cache import PagedKVCache, SlotCache  # noqa: F401
 from tpudl.serve.engine import Engine  # noqa: F401

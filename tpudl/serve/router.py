@@ -97,6 +97,12 @@ class Replica:
         self._inbox: deque = deque()
         self._results: Dict[Any, Result] = {}
         self._results_lock = threading.Lock()
+        #: rid -> measured inbox wait (seconds), popped when the result
+        #: is harvested: the router-door -> engine-admission hop of the
+        #: stitched fleet trace (router TTFT = inbox wait + engine
+        #: TTFT; both are durations, so the sum survives cross-process
+        #: clock skew).
+        self._inbox_waits: Dict[Any, float] = {}
         self._published: dict = {"healthy": True, **session.engine.health()}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -112,7 +118,7 @@ class Replica:
         evaluates the remaining budget when it pops the inbox, so time
         spent queued here counts against the client's deadline instead
         of restarting it."""
-        self._inbox.append((request, deadline_at))
+        self._inbox.append((request, deadline_at, time.monotonic()))
 
     def seat_prefilled(self, item) -> None:
         """Queue an externally prefilled request (engine._Prefilled)
@@ -200,7 +206,21 @@ class Replica:
             while not self._stop.is_set() and not self.failed:
                 worked = False
                 while self._inbox:
-                    request, deadline_at = self._inbox.popleft()
+                    request, deadline_at, enqueued_at = self._inbox.popleft()
+                    inbox_wait = max(0.0, time.monotonic() - enqueued_at)
+                    self._inbox_waits[request.request_id] = inbox_wait
+                    rec = active_recorder()
+                    if rec is not None:
+                        # The replica-inbox hop of the stitched fleet
+                        # trace: a DURATION, so report.py can sum it
+                        # with the engine's hops without comparing this
+                        # process's clock to the router's.
+                        rec.event(
+                            "replica_dequeue", CAT_SERVE_REQUEST,
+                            request_id=request.request_id,
+                            replica=self.name,
+                            inbox_wait_s=inbox_wait,
+                        )
                     if deadline_at is not None:
                         remaining = deadline_at - time.monotonic()
                         if remaining <= 0:
@@ -214,6 +234,7 @@ class Replica:
                                     time.monotonic()
                                     - (deadline_at - request.deadline_s),
                                 )
+                            self._inbox_waits.pop(request.request_id, None)
                             with self._results_lock:
                                 self._results[request.request_id] = Result(
                                     request_id=request.request_id,
@@ -224,6 +245,18 @@ class Replica:
                             registry().counter(
                                 "serve_requests_shed_timeout"
                             ).inc()
+                            if rec is not None:
+                                # Close the trace here: this Result
+                                # never reaches the engine, so no other
+                                # completion event will.
+                                rec.event(
+                                    "request_complete",
+                                    CAT_SERVE_REQUEST,
+                                    request_id=request.request_id,
+                                    finish_reason="shed_timeout",
+                                    queue_wait_s=wait, num_tokens=0,
+                                    shed_by="replica_inbox",
+                                )
                             worked = True
                             continue
                         # Hand the engine only the REMAINING budget —
@@ -239,10 +272,19 @@ class Replica:
                         # (or a duplicate) — surface a Result instead
                         # of swallowing it, or the router would wait
                         # forever.
+                        self._inbox_waits.pop(request.request_id, None)
                         with self._results_lock:
                             self._results[request.request_id] = Result(
                                 request_id=request.request_id, tokens=[],
                                 finish_reason=f"rejected: {e}",
+                            )
+                        if rec is not None:
+                            rec.event(
+                                "request_complete", CAT_SERVE_REQUEST,
+                                request_id=request.request_id,
+                                finish_reason="rejected",
+                                error=str(e), num_tokens=0,
+                                shed_by="replica_inbox",
                             )
                     worked = True
                 if engine.step():
@@ -256,6 +298,25 @@ class Replica:
                     harvested[rid] = engine.results.pop(rid)
                     session._pending_ids.discard(rid)
                 if harvested:
+                    rec = active_recorder()
+                    for rid, res in harvested.items():
+                        wait = self._inbox_waits.pop(rid, None)
+                        if rec is None:
+                            continue
+                        # Router-level TTFT: the inbox hop plus the
+                        # engine-measured TTFT (which, for a
+                        # disaggregated request, already spans from the
+                        # router door — its _Entry was stamped there).
+                        router_ttft = None
+                        if res.ttft_s is not None:
+                            router_ttft = res.ttft_s + (wait or 0.0)
+                        rec.event(
+                            "request_served", CAT_SERVE_REQUEST,
+                            request_id=rid, replica=self.name,
+                            finish_reason=res.finish_reason,
+                            inbox_wait_s=wait,
+                            router_ttft_s=router_ttft,
+                        )
                     with self._results_lock:
                         self._results.update(harvested)
                     worked = True
@@ -480,6 +541,13 @@ class Router:
         # submit() and placement sheds through _shed().
         self._books = threading.RLock()
         self._ready: Dict[str, bool] = {r.name: True for r in replicas}
+        # Replicas being drained for removal: still scraped, harvested,
+        # and failed over, but they take NO new placements — the
+        # drain-then-remove half of autoscaling.
+        self._draining: set = set()
+        # Last scraped health per replica (slots/queue/capacity): the
+        # load_report() the autoscaler reads.
+        self._last_health: Dict[str, dict] = {}
         self._burning: Dict[str, frozenset] = {}
         self._last_scrape = float("-inf")
         self._seq = 0
@@ -565,12 +633,17 @@ class Router:
         self._last_scrape = now
         reg = registry()
         newly_down: List[str] = []
-        for replica in self.replicas:
+        # Snapshot under the books: add_replica/remove_replica mutate
+        # the list from the autoscaler's thread.
+        with self._books:
+            replicas = list(self.replicas)
+        for replica in replicas:
             h = replica.scrape()
             ready = bool(h.get("healthy", True))
             if self._ready.get(replica.name) and not ready:
                 newly_down.append(replica.name)
             self._ready[replica.name] = ready
+            self._last_health[replica.name] = h
             suffix = _metric_suffix(replica.name)
             reg.gauge(f"serve_replica_{suffix}_ready").set(int(ready))
             reg.gauge(f"serve_replica_{suffix}_slots_busy").set(
@@ -582,6 +655,7 @@ class Router:
         reg.gauge("serve_router_ready_replicas").set(
             sum(1 for v in self._ready.values() if v)
         )
+        reg.gauge("serve_router_total_replicas").set(len(replicas))
         reg.gauge("serve_router_autoscale_hint").set(self._autoscale_hint())
         for name in newly_down:
             self._failover(name)
@@ -591,7 +665,12 @@ class Router:
         its results to date are harvested first (completed work is
         kept), the rest restart on surviving replicas. Sticky keys
         pinned to the dead replica are released."""
-        replica = next(r for r in self.replicas if r.name == name)
+        with self._books:
+            replica = next(
+                (r for r in self.replicas if r.name == name), None
+            )
+        if replica is None:  # removed concurrently: nothing to rescue
+            return
         self._harvest_one(replica)
         with self._books:
             doomed = [
@@ -637,13 +716,18 @@ class Router:
                 # the restarted copy is authoritative; drop this one.
 
     def _harvest(self) -> None:
-        for replica in self.replicas:
+        with self._books:
+            replicas = list(self.replicas)
+        for replica in replicas:
             self._harvest_one(replica)
 
     # -- placement ------------------------------------------------------
 
     def _ready_replicas(self) -> List[Replica]:
-        return [r for r in self.replicas if self._ready.get(r.name)]
+        return [
+            r for r in self.replicas
+            if self._ready.get(r.name) and r.name not in self._draining
+        ]
 
     def _least_loaded(self) -> Optional[Replica]:
         ready = self._ready_replicas()
@@ -759,13 +843,26 @@ class Router:
                     deadline=deadline_at,
                     submitted_at=now,
                 ))
+                routed_to = {"worker": worker.name}
             else:
                 if request.session_key is not None:
                     self._sticky[request.session_key] = target.name
                 self._assigned[rid] = (target.name, request)
                 self._inflight[target.name] += request.max_new_tokens
                 target.submit(request, deadline_at)
+                routed_to = {"replica": target.name}
         registry().counter("serve_router_requests_routed").inc()
+        rec = active_recorder()
+        if rec is not None:
+            # The router-door marker of the stitched fleet trace: names
+            # the hop the request was handed to, so report.py can warn
+            # "partial trace" when that hop's stream is missing from
+            # disk.
+            rec.event(
+                "request_routed", CAT_SERVE_REQUEST,
+                request_id=rid, priority=request.priority,
+                **routed_to,
+            )
         return rid
 
     def _pick(self, request: Request) -> Optional[Replica]:
@@ -773,7 +870,11 @@ class Router:
         least-loaded ready replica. Callers hold ``_books``."""
         if request.session_key is not None:
             pinned = self._sticky.get(request.session_key)
-            if pinned is not None and self._ready.get(pinned):
+            if (
+                pinned is not None
+                and self._ready.get(pinned)
+                and pinned not in self._draining
+            ):
                 return next(
                     r for r in self.replicas if r.name == pinned
                 )
@@ -809,6 +910,169 @@ class Router:
             self._assigned[rid] = (target.name, request)
             self._inflight[target.name] += request.max_new_tokens
         target.seat_prefilled(item)
+
+    # -- live fleet membership (the autoscaler's surface) ---------------
+
+    def add_replica(self, replica: Replica) -> Replica:
+        """Grow the fleet live: start ``replica``, enter it into the
+        routing books, subscribe its SLO monitor, and scrape it so the
+        next placement can use it. The replica must share the fleet's
+        compiled shapes (admission validation happened against them)."""
+        session = replica.session
+        if (
+            session.prompt_len != self._prompt_len
+            or session.max_seq_len != self._max_seq_len
+        ):
+            raise ValueError(
+                f"replica {replica.name!r} compiled shapes "
+                f"(prompt_len={session.prompt_len}, "
+                f"max_seq_len={session.max_seq_len}) do not match the "
+                f"fleet's ({self._prompt_len}, {self._max_seq_len})"
+            )
+        with self._books:
+            if any(r.name == replica.name for r in self.replicas):
+                raise ValueError(
+                    f"duplicate replica name {replica.name!r}"
+                )
+            self.replicas.append(replica)
+            self._inflight[replica.name] = 0
+            self._ready[replica.name] = True
+        replica.start()
+        slo = session.engine._slo
+        if slo is not None:
+            self._subscribe_slo(replica.name, slo)
+        registry().counter("serve_router_replicas_added").inc()
+        rec = active_recorder()
+        if rec is not None:
+            rec.event(
+                "replica_added", CAT_SERVE_REQUEST, replica=replica.name
+            )
+        self._scrape(force=True)
+        return replica
+
+    def remove_replica(
+        self,
+        name: str,
+        drain: bool = True,
+        timeout_s: Optional[float] = None,
+    ) -> Replica:
+        """Shrink the fleet live. ``drain=True`` (the autoscaler's
+        scale-down): the replica takes no new placements, its sticky
+        pins are released, and removal WAITS until every request
+        assigned to it has produced a Result — a drain never drops
+        in-flight work. ``drain=False`` stops it immediately and fails
+        its outstanding work over to the survivors (the replacement
+        path for a sick replica).
+
+        On drain timeout the replica is returned to service (draining
+        flag cleared) and TimeoutError raises — half-removed state is
+        never left behind."""
+        with self._books:
+            replica = next(
+                (r for r in self.replicas if r.name == name), None
+            )
+            if replica is None:
+                raise ValueError(f"no replica named {name!r}")
+            self._draining.add(name)
+            self._sticky = {
+                k: v for k, v in self._sticky.items() if v != name
+            }
+        deadline = (
+            None if timeout_s is None else self.clock() + timeout_s
+        )
+        if drain:
+            while True:
+                self._scrape()
+                self._harvest()
+                with self._books:
+                    outstanding = sum(
+                        1 for owner, _ in self._assigned.values()
+                        if owner == name
+                    )
+                if outstanding == 0:
+                    break
+                if deadline is not None and self.clock() > deadline:
+                    with self._books:
+                        self._draining.discard(name)
+                    raise TimeoutError(
+                        f"remove_replica({name!r}): {outstanding} "
+                        f"requests still in flight after {timeout_s}s"
+                    )
+                time.sleep(0.001)
+        replica.stop()
+        self._harvest_one(replica)
+        if not drain:
+            # Outstanding work moves to the survivors before the books
+            # forget this replica existed.
+            self._failover(name)
+        with self._books:
+            self.replicas = [r for r in self.replicas if r.name != name]
+            self._inflight.pop(name, None)
+            self._ready.pop(name, None)
+            self._draining.discard(name)
+            self._burning.pop(name, None)
+            self._last_health.pop(name, None)
+            ready = sum(1 for v in self._ready.values() if v)
+            total = len(self.replicas)
+        reg = registry()
+        suffix = _metric_suffix(name)
+        reg.gauge(f"serve_replica_{suffix}_ready").set(0)
+        reg.gauge("serve_router_ready_replicas").set(ready)
+        reg.gauge("serve_router_total_replicas").set(total)
+        reg.counter("serve_router_replicas_removed").inc()
+        rec = active_recorder()
+        if rec is not None:
+            rec.event(
+                "replica_removed", CAT_SERVE_REQUEST, replica=name,
+                drained=drain,
+            )
+        return replica
+
+    def autoscale_hint(self) -> int:
+        """Public read of the scale-out signal the
+        ``serve_router_autoscale_hint`` gauge publishes."""
+        return self._autoscale_hint()
+
+    def load_report(self) -> dict:
+        """One fleet-load sample from the last scrape — the signal set
+        the Autoscaler's hysteresis runs on. ``busy_frac`` is occupied
+        capacity over total capacity of the PLACEABLE (ready,
+        non-draining) replicas; ``queue_frac`` the same for admission
+        queues alone."""
+        self._scrape()
+        with self._books:
+            active = [
+                r for r in self.replicas
+                if r.name not in self._draining
+            ]
+            busy = cap = qdepth = qcap = 0.0
+            per_replica: Dict[str, dict] = {}
+            for r in active:
+                h = self._last_health.get(r.name, {})
+                r_busy = h.get("slots_busy", 0) + h.get("queue_depth", 0)
+                busy += r_busy
+                cap += h.get("num_slots", 0) + h.get("queue_capacity", 0)
+                qdepth += h.get("queue_depth", 0)
+                qcap += h.get("queue_capacity", 0)
+                per_replica[r.name] = {
+                    "ready": bool(self._ready.get(r.name)),
+                    "busy": r_busy,
+                    "inflight_tokens": self._inflight.get(r.name, 0),
+                }
+            return {
+                "per_replica": per_replica,
+                "replicas": len(self.replicas),
+                "active_replicas": len(active),
+                "ready_replicas": sum(
+                    1 for v in self._ready.values() if v
+                ),
+                "draining": sorted(self._draining),
+                "busy_frac": busy / cap if cap else 0.0,
+                "queue_frac": qdepth / qcap if qcap else 0.0,
+                "outstanding": len(self._assigned),
+                "burning": self.burning,
+                "autoscale_hint": self._autoscale_hint(),
+            }
 
     # -- the request lifecycle ------------------------------------------
 
