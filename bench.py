@@ -473,6 +473,19 @@ def _bench_parity_grid():
     return measure_parity_grid()
 
 
+def _bench_prefix_spec():
+    """Prefix-sharing + speculative-decoding tier (benchmarks/
+    serve_load.py): p50 TTFT on the 50%-shared-prefix ragged mix with
+    radix sharing on (asserted >= 2x under no-sharing inside the
+    benchmark), accepted-tokens-per-step of the greedy int8 self-draft
+    (asserted >= 2), and speculative tokens/sec on the simulated
+    device (asserted above the non-speculative baseline). Banked from
+    r07 onward (new keys enter as no-baseline on their first round)."""
+    from benchmarks.serve_load import measure_prefix_spec
+
+    return measure_prefix_spec()
+
+
 def _bench_block_pins():
     """ROADMAP item-1 follow-through: run the fused-epilogue
     block-size sweep and record the winning env pins in the JSON tail,
@@ -621,6 +634,15 @@ def main(argv=None):
         traceback.print_exc()
         parity_grid = {}
     try:
+        prefix_spec = _bench_prefix_spec()
+    except Exception:
+        import sys
+        import traceback
+
+        print("prefix/spec bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        prefix_spec = {}
+    try:
         block_pins = _bench_block_pins()
     except Exception:
         import sys
@@ -768,6 +790,22 @@ def main(argv=None):
         ),
         "parity_grid_cells_passed": parity_grid.get(
             "parity_grid_cells_passed"
+        ),
+        # Prefix-sharing + speculative decoding (tpudl.serve radix
+        # cache + speculate via benchmarks/serve_load.py): p50 TTFT on
+        # the 50%-shared-prefix mix with sharing on (the benchmark
+        # asserts >= 2x vs no-sharing), per-stream accepted tokens per
+        # speculative window (>= 2 asserted), and speculative
+        # tokens/sec on the simulated device (beats the plain paged
+        # baseline, asserted).
+        "serve_ttft_shared_prefix_ms": prefix_spec.get(
+            "serve_ttft_shared_prefix_ms"
+        ),
+        "spec_accepted_tokens_per_step": prefix_spec.get(
+            "spec_accepted_tokens_per_step"
+        ),
+        "serve_tokens_per_sec_spec": prefix_spec.get(
+            "serve_tokens_per_sec_spec"
         ),
         # JSON tail: the fused-epilogue block-size sweep's winning
         # pins (benchmarks/fused_epilogue.py --sweep-blocks) — the

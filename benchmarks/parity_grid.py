@@ -7,13 +7,19 @@ generalizes that into a first-class matrix over the serving decoder:
 
 - **precision** rows: ``f32`` (the reference), ``bf16`` compute,
   ``int8`` weights (tpudl.quant), ``int8+kv8`` (int8 weights composed
-  with the PR-8 paged int8 KV cache), ``fp8`` (e4m3 weights);
+  with the PR-8 paged int8 KV cache), ``fp8`` (e4m3 weights),
+  ``prefix`` (f32 paged + radix prefix sharing — EXACT parity: COW
+  addressing must never change tokens), and ``spec`` (speculative
+  decoding, int8 self-draft — margin-mode parity: the chunked verify
+  program may flip genuine near-ties);
 - **backend** columns: ``compiled`` (live jitted ServeSession) and
   ``exported`` (StableHLO artifacts through
-  tpudl.export.decode.export_serving_decoder -> from_artifacts) —
-  exported cells auto-skip when jax.export is unavailable
-  (tpudl.export.export.EXPORT_AVAILABLE), mirroring the test tier's
-  conftest guard.
+  tpudl.export.decode.export_serving_decoder -> from_artifacts; paged
+  cells export the page-pool contract and from_artifacts recovers the
+  geometry from avals) — exported cells auto-skip when jax.export is
+  unavailable (tpudl.export.export.EXPORT_AVAILABLE), mirroring the
+  test tier's conftest guard; prefix/spec cells skip the exported
+  column loudly (they need live chunk/draft programs).
 
 Every cell runs ``assert_serving_parity`` against the f32 reference
 model at a per-cell tolerance: exact token equality for f32 cells,
@@ -58,15 +64,24 @@ HBM_GBPS = 819.0
 #: Per-cell parity tolerance: None = exact token equality (the f32
 #: contract), else assert_serving_parity's teacher-forced logit-margin
 #: atol (quantized/bf16 compute may flip genuine near-ties only).
+#: ``prefix`` (f32 paged + radix prefix sharing) is EXACT — a request
+#: seated against a cached prefix must produce byte-identical tokens
+#: to a cold run; ``spec`` (speculative decoding, int8 self-draft)
+#: rides margin mode — the chunked verify program may flip genuine
+#: near-ties vs the single-token program, wide margins still fire.
 CELL_ATOL = {
     "f32": None,
     "bf16": 0.15,
     "int8": 0.06,
     "int8+kv8": 0.10,
     "fp8": 0.06,
+    "prefix": None,
+    "spec": 0.06,
 }
-PRECISIONS = ("f32", "bf16", "int8", "int8+kv8", "fp8")
+PRECISIONS = ("f32", "bf16", "int8", "int8+kv8", "fp8", "prefix", "spec")
 BACKENDS = ("compiled", "exported")
+#: Speculation window for the ``spec`` row.
+SPEC_K = 3
 
 
 class CellUnrunnable(RuntimeError):
@@ -118,38 +133,82 @@ def _precision_variant(model, params, precision: str):
     if precision == "fp8":
         m, p = quantize_model(model, params, "fp8_e4m3")
         return m, p, {}
+    if precision == "prefix":
+        # Page size must divide into the shared prefix (PROMPT_LEN/2)
+        # for full-block hits to exist at this tiny prompt window.
+        return model, params, {
+            "paged": True, "prefix_share": True, "page_size": 4,
+        }
+    if precision == "spec":
+        return model, params, {"paged": True, "spec_k": SPEC_K}
     raise ValueError(f"unknown precision {precision!r}")
 
 
-def _make_requests(n, cell: str, seed=0, max_new=(4, 16), vocab=512):
+def _make_requests(n, cell: str, seed=0, max_new=(4, 16), vocab=512,
+                   shared_prefix: int = 0):
+    """``shared_prefix`` > 0 gives every request one common prefix of
+    that many tokens plus a ragged unique tail — the workload shape
+    that exercises the radix cell's hit path (request 0 seeds, the
+    rest seat against cached pages)."""
     from tpudl.serve import Request
 
     rng = np.random.default_rng(seed)
-    return [
-        Request(
+    if not shared_prefix:
+        # The pre-existing cells' exact draw, untouched: banked grid
+        # latencies stay comparable across rounds.
+        return [
+            Request(
+                request_id=f"{cell}-{i}",
+                input_ids=rng.integers(
+                    1, vocab, size=int(rng.integers(2, PROMPT_LEN + 1))
+                ).tolist(),
+                max_new_tokens=int(rng.integers(*max_new)),
+            )
+            for i in range(n)
+        ]
+    prefix = rng.integers(1, vocab, size=shared_prefix).tolist()
+    out = []
+    for i in range(n):
+        tail = rng.integers(
+            1, vocab,
+            size=int(rng.integers(1, PROMPT_LEN - shared_prefix + 1)),
+        ).tolist()
+        out.append(Request(
             request_id=f"{cell}-{i}",
-            input_ids=rng.integers(
-                1, vocab, size=int(rng.integers(2, PROMPT_LEN + 1))
-            ).tolist(),
+            input_ids=prefix + tail,
             max_new_tokens=int(rng.integers(*max_new)),
-        )
-        for i in range(n)
-    ]
+        ))
+    return out
 
 
 def _cell_bytes(params_v, session) -> dict:
     """The cell's bytes-moved-per-token model: every weight byte plus
     the resident KV pool read once per decode step (decode is
     bandwidth-bound; this is the idealized floor the ceiling column
-    scales to HBM speed)."""
+    scales to HBM speed).
+
+    Speculative cells amortize: one window moves k draft reads (draft
+    weights + draft KV) plus one target read, and emits up to k
+    tokens — bytes/token is the window total over k, the
+    full-acceptance ceiling the measured acceptance discounts.
+    Prefix cells keep the f32 paged model (sharing changes RESIDENT
+    bytes per request and prefill compute, not per-decode-token
+    traffic)."""
     from tpudl.quant import weight_bytes_report
 
     report = weight_bytes_report(params_v)
     kv_bytes = session.engine.cache.nbytes
+    per_token = report["total_bytes"] + int(kv_bytes)
+    spec = session.engine.speculator
+    if spec is not None:
+        draft_read = spec.weight_bytes + spec.cache.nbytes
+        per_token = (
+            spec.k * draft_read + report["total_bytes"] + int(kv_bytes)
+        ) // spec.k
     return {
         "weight_bytes": report["total_bytes"],
         "kv_bytes": int(kv_bytes),
-        "bytes_per_token": report["total_bytes"] + int(kv_bytes),
+        "bytes_per_token": per_token,
         "quant_ratio": report["quant_ratio"],
         "quantized_layer_bytes": report["quantized_layer_bytes"],
         "quantized_layer_f32_bytes": report["quantized_layer_f32_bytes"],
@@ -178,12 +237,26 @@ def build_cell_session(
     from tpudl.export.export import EXPORT_AVAILABLE
     if not EXPORT_AVAILABLE:
         raise CellUnrunnable("jax.export unavailable")
-    if session_kwargs.get("paged"):
-        # The paged decode contract (host-owned page tables as extra
-        # traced inputs) has no exported-artifact session yet.
-        raise CellUnrunnable("paged KV cells serve compiled-only")
+    if session_kwargs.get("prefix_share") or session_kwargs.get("spec_k"):
+        # Sharing needs the live chunked suffix-prefill program and
+        # speculation the live draft+verify pair — neither is part of
+        # the exported artifact contract (yet).
+        raise CellUnrunnable(
+            "prefix/spec cells need live programs; serve compiled-only"
+        )
     from tpudl.export.decode import export_serving_decoder
 
+    if session_kwargs.get("paged"):
+        # The paged decode contract round-trips through StableHLO: the
+        # page pools are the cache avals, the host addressing arrays
+        # ride as extra inputs, and from_artifacts recovers the whole
+        # geometry from shapes (ROADMAP item 6's exported-paged cell).
+        pre, dec = export_serving_decoder(
+            model_v, params_v, num_slots=num_slots,
+            prompt_len=PROMPT_LEN, paged=True,
+            kv_dtype=session_kwargs.get("kv_dtype"),
+        )
+        return ServeSession.from_artifacts(pre, dec, params_v, paged=True)
     pre, dec = export_serving_decoder(
         model_v, params_v, num_slots=num_slots, prompt_len=PROMPT_LEN
     )
@@ -224,18 +297,46 @@ def run_cell(
     # -- parity gate (before the sim wrapper: the gate is about
     # tokens, and unslowed decode keeps the grid fast) --------------
     atol = CELL_ATOL[precision]
+    shared_prefix = PROMPT_LEN // 2 if precision == "prefix" else 0
     assert_serving_parity(
         session, ref_model, ref_params,
-        _make_requests(n_parity, cell, seed=seed), atol=atol,
+        _make_requests(
+            n_parity, cell, seed=seed, shared_prefix=shared_prefix
+        ),
+        atol=atol,
     )
+    if precision == "prefix":
+        hits = session.engine.cache.radix.stats()
+        assert hits["nodes"] > 0, (
+            "prefix cell never populated the radix tree — the parity "
+            "gate did not exercise the shared path"
+        )
 
     # -- simulated-device latency -----------------------------------
     session.engine.decode_call = _with_sim_latency(
         session.engine.decode_call, sim_step_s
     )
+    if session.engine.speculator is not None:
+        # Spec cells pace the verify dispatch at the TARGET's full
+        # weight+KV read (one window always moves all of it — the
+        # amortized bytes/token would understate measured TPOT against
+        # the cell's own model) and the draft at its own measured read.
+        target_read = (
+            bytes_model["weight_bytes"] + bytes_model["kv_bytes"]
+        )
+        session.engine.verify_call = _with_sim_latency(
+            session.engine.verify_call,
+            target_read / (sim_bw_gbps * 1e9),
+        )
+        spec = session.engine.speculator
+        draft_bytes = spec.weight_bytes + spec.cache.nbytes
+        spec.decode_call = _with_sim_latency(
+            spec.decode_call, draft_bytes / (sim_bw_gbps * 1e9)
+        )
     lat_reqs = _make_requests(
         n_latency, cell + "-lat", seed=seed + 1,
         max_new=(latency_tokens, latency_tokens + 1),
+        shared_prefix=shared_prefix,
     )
     t0 = time.perf_counter()
     results = session.serve(lat_reqs)

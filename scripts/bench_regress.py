@@ -90,6 +90,16 @@ NOISE_BAND_FLOORS = {
     # tail the container's scheduler owns.
     "autoscale_recovery_s": 0.60,
     "fleet_scrape_overhead_ms": 0.60,
+    # Prefix-sharing + speculative keys (benchmarks/serve_load.py
+    # --prefix/--spec, banked from r07). TTFT rides simulated prefill
+    # sleeps queued across slots (scheduler-owned tail on 1 vCPU);
+    # acceptance is a near-deterministic property of the int8
+    # self-draft (greedy agreement), so a real drop means the draft or
+    # the acceptance rule changed; spec tokens/sec rides the sim
+    # device + host dispatch mix.
+    "serve_ttft_shared_prefix_ms": 0.50,
+    "spec_accepted_tokens_per_step": 0.15,
+    "serve_tokens_per_sec_spec": 0.30,
 }
 DEFAULT_BAND_FLOOR = 0.08
 
@@ -104,6 +114,7 @@ LOWER_IS_BETTER = {
     "step_dispatch_overhead_ms",
     "autoscale_recovery_s",
     "fleet_scrape_overhead_ms",
+    "serve_ttft_shared_prefix_ms",
 }
 
 #: Non-measurement keys in a bench line: identifiers, config echoes,

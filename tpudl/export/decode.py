@@ -99,17 +99,62 @@ def export_serving_decoder(
     prompt_len: int,
     path_prefix: Optional[str] = None,
     platforms: Optional[Sequence[str]] = None,
+    paged: bool = False,
+    page_size: int = 16,
+    kv_dtype: Optional[str] = None,
+    num_pages: Optional[int] = None,
 ) -> Tuple[bytes, bytes]:
     """Export the artifact pair the continuous-batching engine serves
     (tpudl.serve): a BATCH-1 prefill (requests are seated one at a
     time) and a batch-``num_slots`` decode (all slots step together).
     ``ServeSession.from_artifacts`` recovers every shape it needs from
-    these blobs — no side-channel metadata."""
-    return export_decoder(
-        model, params, 1, prompt_len,
-        path_prefix=path_prefix, platforms=platforms,
-        decode_batch_size=num_slots,
+    these blobs — no side-channel metadata.
+
+    ``paged=True`` exports the PAGED decode contract instead
+    (tpudl.models.generate.paged_decode_fn): the cache input is the
+    page-pool pytree and three host-owned addressing arrays (page
+    table, start, lens) ride as extra traced inputs — seating/freeing
+    against the deserialized program never recompiles, exactly like
+    the live path. ``page_size``/``kv_dtype``/``num_pages`` fix the
+    exported pool geometry (a PagedKVCache at the same settings);
+    ``from_artifacts`` reads it all back from the avals."""
+    if not paged:
+        return export_decoder(
+            model, params, 1, prompt_len,
+            path_prefix=path_prefix, platforms=platforms,
+            decode_batch_size=num_slots,
+        )
+    from tpudl.models.generate import paged_decode_fn
+    from tpudl.serve.cache import PagedKVCache
+
+    pf = prefill_fn(model)
+    ids = jnp.zeros((1, prompt_len), jnp.int32)
+    mask = jnp.ones((1, prompt_len), jnp.int32)
+    _, template = jax.eval_shape(
+        pf,
+        params,
+        jnp.zeros((num_slots, prompt_len), jnp.int32),
+        jnp.ones((num_slots, prompt_len), jnp.int32),
     )
+    cache = PagedKVCache(
+        template, page_size=page_size, num_pages=num_pages,
+        kv_dtype=kv_dtype,
+    )
+    token = jnp.zeros((num_slots,), jnp.int32)
+    position = jnp.full((num_slots,), prompt_len, jnp.int32)
+    prefill_blob = export_stablehlo(
+        pf,
+        (params, ids, mask),
+        path=f"{path_prefix}.prefill.stablehlo" if path_prefix else None,
+        platforms=platforms,
+    )
+    decode_blob = export_stablehlo(
+        paged_decode_fn(model, cache.page_size, cache.quantized),
+        (params, cache.cache, token, position, *cache.dispatch_args()),
+        path=f"{path_prefix}.decode.stablehlo" if path_prefix else None,
+        platforms=platforms,
+    )
+    return prefill_blob, decode_blob
 
 
 def generate_with_exported(

@@ -97,6 +97,65 @@ def paged_decode_fn(model, page_size: int, quantized: bool):
     return fn
 
 
+def chunk_prefill_fn(model):
+    """THE suffix-prefill contract for prefix-sharing serving
+    (tpudl.serve.cache radix mode): ``(params, cache, tokens [B, C],
+    positions [B, C]) -> (last_logits, cache)``. The provided ``cache``
+    already holds the SHARED prefix KV (gathered out of radix-tree
+    pages into dense rows, ``index`` pinned at the prefix length); this
+    runs only the C unshared suffix tokens through the dense decode
+    branch — which writes the chunk at ``index``..``index+C`` and
+    attends slot-order-causally over prefix + chunk — so prefill cost
+    is O(suffix), not O(prompt window). Positions are ABSOLUTE (token
+    index in the unpadded prompt), keeping RoPE phases identical to a
+    cold full prefill."""
+
+    def fn(params, cache, tokens, positions):
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tokens,
+            jnp.ones_like(tokens),
+            decode=True,
+            positions=positions,
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return fn
+
+
+def paged_chunk_decode_fn(model, page_size: int, quantized: bool):
+    """THE speculative-verify contract: ``(params, cache, tokens
+    [B, C], positions [B, C], page_table, start, lens) -> (logits
+    [B, C, V], new_cache)``. One slot-batched dispatch writes each
+    slot's C-token window into its pages (token j at logical position
+    ``lens + j``) and returns the logits for EVERY window position —
+    the target model's verdict on all k draft proposals at once
+    (tpudl.serve.speculate). Causality within the window rides the
+    chunked paged mask; rejected tails roll back on the host by simply
+    not advancing ``lens`` past the accepted count (the garbage rows
+    are masked and overwritten by the next window)."""
+    from tpudl.models.paged import PagedView
+
+    def fn(params, cache, tokens, positions, page_table, start, lens):
+        view = PagedView(
+            page_table=page_table, start=start, lens=lens,
+            page_size=page_size, quantized=quantized,
+        )
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tokens,
+            jnp.ones_like(tokens),
+            decode=True,
+            positions=positions,
+            paged=view,
+            mutable=["cache"],
+        )
+        return logits, mutated["cache"]
+
+    return fn
+
+
 @functools.partial(jax.jit, static_argnums=(0,))
 def _prefill(model, params, input_ids, attention_mask):
     return prefill_fn(model)(params, input_ids, attention_mask)

@@ -236,20 +236,17 @@ class LlamaAttention(nn.Module):
             # dense [B, max_seq] rows below — each slot has its OWN
             # length (no shared write index, so no horizon rollover)
             # and pools may store int8 with per-(page, row, head)
-            # dequant scales fused into the gather. Single-token steps
-            # only: prefill stays dense batch-1 (its row cache is
-            # scattered into pages by PagedKVCache.seat).
+            # dequant scales fused into the gather. Token chunks of any
+            # length step together (S=1 is the plain decode step; S=k
+            # is the speculative-verify window, causal within itself
+            # via the chunked mask); prefill stays dense batch-1 (its
+            # row cache is scattered into pages by PagedKVCache.seat).
             from tpudl.models.paged import (
                 paged_attend_mask,
                 paged_gather,
                 paged_write,
             )
 
-            if S != 1:
-                raise ValueError(
-                    f"paged decode is single-token (got chunk length "
-                    f"{S}); prefill runs through the dense batch-1 path"
-                )
             pk = self.variable("cache", "pages_k", _paged_cache_missing)
             pv = self.variable("cache", "pages_v", _paged_cache_missing)
             sk = sv = None
@@ -257,10 +254,10 @@ class LlamaAttention(nn.Module):
                 sk = self.variable("cache", "scale_k", _paged_cache_missing)
                 sv = self.variable("cache", "scale_v", _paged_cache_missing)
             new_k, new_sk = paged_write(
-                pk.value, sk.value if sk is not None else None, k[:, 0], paged
+                pk.value, sk.value if sk is not None else None, k, paged
             )
             new_v, new_sv = paged_write(
-                pv.value, sv.value if sv is not None else None, v[:, 0], paged
+                pv.value, sv.value if sv is not None else None, v, paged
             )
             pk.value, pv.value = new_k, new_v
             if paged.quantized:
@@ -271,7 +268,9 @@ class LlamaAttention(nn.Module):
             vf = paged_gather(
                 pv.value, sv.value if sv is not None else None, paged, v.dtype
             )
-            ctx = _gqa_decode_attention(q, kf, vf, paged_attend_mask(paged))
+            ctx = _gqa_decode_attention(
+                q, kf, vf, paged_attend_mask(paged, chunk=S)
+            )
             ctx = ctx.reshape(B, S, cfg.num_heads * hd)
             return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
 

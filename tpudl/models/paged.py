@@ -96,24 +96,35 @@ def paged_write(
     value: jax.Array,
     view: PagedView,
 ):
-    """Write one token's KV per slot into its current page row.
+    """Write a token chunk's KV per slot into its current page rows.
 
     ``pages`` [NP, ps, Hkv, D] (int8 or compute dtype), ``scales``
-    [NP, ps, Hkv] f32 (quantized pools only), ``value`` [B, Hkv, D]
-    (the freshly projected + RoPE'd k or v). Slot b lands at physical
-    ``(page_table[b, lens[b] // ps], lens[b] % ps)``; idle slots (lens
-    pinned at 0 on a trash-mapped row) write into page 0, which no live
-    slot maps."""
-    b = value.shape[0]
+    [NP, ps, Hkv] f32 (quantized pools only), ``value`` [B, S, Hkv, D]
+    (the freshly projected + RoPE'd k or v; [B, Hkv, D] is accepted as
+    the S=1 single-token form). Token j of slot b lands at physical
+    ``(page_table[b, (lens[b]+j) // ps], (lens[b]+j) % ps)`` — the
+    speculative-verify dispatch writes its whole k-token window this
+    way; idle slots (lens pinned at 0 on a trash-mapped row) write into
+    page 0, which no live slot maps. Positions past the table's logical
+    capacity (a verify window overshooting a nearly-full slot) redirect
+    to the trash page instead of clamping onto the slot's last page —
+    a clamped write would corrupt KEPT rows of the same slot."""
+    if value.ndim == 3:
+        value = value[:, None]
+    s = value.shape[1]
     ps = view.page_size
+    p = view.page_table.shape[1]
+    pos = view.lens[:, None] + jnp.arange(s, dtype=view.lens.dtype)[None, :]
+    pidx = pos // ps
     page = jnp.take_along_axis(
-        view.page_table, (view.lens // ps)[:, None], axis=1
-    )[:, 0]
-    off = view.lens % ps
+        view.page_table, jnp.minimum(pidx, p - 1), axis=1
+    )
+    page = jnp.where(pidx < p, page, 0)
+    off = pos % ps
     if view.quantized:
-        q, s = quantize_kv(value)
+        q, sc = quantize_kv(value)
         pages = pages.at[page, off].set(q)
-        scales = scales.at[page, off].set(s)
+        scales = scales.at[page, off].set(sc)
     else:
         pages = pages.at[page, off].set(value.astype(pages.dtype))
     return pages, scales
@@ -147,11 +158,16 @@ def paged_gather(
     return out.astype(compute_dtype)
 
 
-def paged_attend_mask(view: PagedView) -> jax.Array:
-    """[B, 1, 1, L] bool — attend logical positions in
-    [start, lens] inclusive (lens = the just-written current token)."""
+def paged_attend_mask(view: PagedView, chunk: int = 1) -> jax.Array:
+    """[B, 1, S, L] bool — query j of the chunk attends logical
+    positions in [start, lens + j] inclusive (lens + j = where query
+    j's own token was just written), so a multi-token verify chunk is
+    causal within itself exactly like sequential single-token steps."""
     pos = jnp.arange(view.logical_len)
-    mask = (pos[None, :] >= view.start[:, None]) & (
-        pos[None, :] <= view.lens[:, None]
+    upper = view.lens[:, None] + jnp.arange(
+        chunk, dtype=view.lens.dtype
+    )[None, :]
+    mask = (pos[None, None, :] >= view.start[:, None, None]) & (
+        pos[None, None, :] <= upper[:, :, None]
     )
-    return mask[:, None, None, :]
+    return mask[:, None, :, :]

@@ -399,15 +399,55 @@ def build_request_timeline(records: Iterable[dict], request_id) -> dict:
         timeline.append({
             "ts": float(prefill["ts"]), "dur": float(prefill["dur"]),
             "what": "prefill",
+            # prefix_hit_tokens: how much of the prompt the radix
+            # prefix cache served for free — the TTFT attribution
+            # (prefill dur covers only the unshared suffix when > 0).
             "detail": {"slot": prefill.get("slot"),
-                       "worker": prefill.get("worker")},
+                       "worker": prefill.get("worker"),
+                       "prefix_hit_tokens": prefill.get(
+                           "prefix_hit_tokens")},
             "record": prefill,
         })
+    def _spec_share(c: dict):
+        """THIS request's (accepted, proposed, emitted) within one
+        speculative window: the decode_step span batch-sums its
+        numbers, but slot_accepted/slot_emitted align with rids, so a
+        single request's trace reads its own column instead of
+        claiming the whole batch's."""
+        if c.get("proposed") is None:
+            return None
+        idx = next(
+            (j for j, x in enumerate(c.get("rids") or ())
+             if _match(x)), None,
+        )
+        slot_acc = c.get("slot_accepted")
+        if idx is not None and slot_acc is not None:
+            return (
+                int(slot_acc[idx]),
+                int(c.get("proposed_per_slot") or 0),
+                int((c.get("slot_emitted") or [0] * (idx + 1))[idx]),
+            )
+        # Older streams without per-slot columns: batch totals are the
+        # best available (overstates under multi-slot occupancy).
+        return (
+            int(c.get("accepted") or 0), int(c.get("proposed") or 0),
+            int(c.get("emitted") or 0),
+        )
+
     for i, c in enumerate(decode_chunks):
+        detail = {"index": i, "busy": c.get("busy")}
+        share = _spec_share(c)
+        if share is not None:
+            # Speculative windows: accepted/proposed per step shows
+            # where TPOT went (a low ratio = the draft disagrees and
+            # windows are mostly wasted draft dispatches).
+            detail["accepted"], detail["proposed"], detail["emitted"] = (
+                share
+            )
         timeline.append({
             "ts": float(c["ts"]), "dur": float(c["dur"]),
             "what": "decode_chunk",
-            "detail": {"index": i, "busy": c.get("busy")},
+            "detail": detail,
             "record": c,
         })
     if served is not None:
@@ -510,6 +550,24 @@ def build_request_timeline(records: Iterable[dict], request_id) -> dict:
         "num_tokens": (
             complete.get("num_tokens") if complete is not None else None
         ),
+        "prefix_hit_tokens": (
+            prefill.get("prefix_hit_tokens")
+            if prefill is not None else None
+        ),
+        "speculation": (
+            {
+                "proposed": sum(
+                    s[1] for s in map(_spec_share, decode_chunks)
+                    if s is not None
+                ),
+                "accepted": sum(
+                    s[0] for s in map(_spec_share, decode_chunks)
+                    if s is not None
+                ),
+            }
+            if any(c.get("proposed") is not None for c in decode_chunks)
+            else None
+        ),
         "timeline": timeline,
         "decomposition": {
             "inbox_wait_s": inbox_wait_s,
@@ -553,6 +611,17 @@ def format_request_timeline(tl: dict) -> str:
     ]
     for w in tl.get("warnings", ()):
         lines.append(f"WARNING: {w}")
+    if tl.get("prefix_hit_tokens"):
+        lines.append(
+            f"prefix cache: {tl['prefix_hit_tokens']} prompt tokens "
+            f"served from shared pages (prefill paid only the suffix)"
+        )
+    spec = tl.get("speculation")
+    if spec and spec.get("proposed"):
+        lines.append(
+            f"speculation: {spec['accepted']}/{spec['proposed']} "
+            f"proposed tokens accepted across decode windows"
+        )
     lines += [
         "",
         f"{'t_ms':>10} {'dur_ms':>9}  event"

@@ -28,9 +28,14 @@ from tpudl.serve.autoscale import (  # noqa: F401
     AutoscaleConfig,
     Autoscaler,
 )
-from tpudl.serve.cache import PagedKVCache, SlotCache  # noqa: F401
+from tpudl.serve.cache import (  # noqa: F401
+    PagedKVCache,
+    RadixPrefixTree,
+    SlotCache,
+)
 from tpudl.serve.engine import Engine  # noqa: F401
 from tpudl.serve.queue import AdmissionQueue  # noqa: F401
+from tpudl.serve.speculate import Speculator  # noqa: F401
 from tpudl.serve.router import (  # noqa: F401
     PrefillWorker,
     Replica,

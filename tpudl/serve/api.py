@@ -26,7 +26,11 @@ artifact sessions carry theirs in the decode program's batch dim),
 ``TPUDL_SERVE_QUEUE_DEPTH`` (admission queue capacity),
 ``TPUDL_SERVE_PAGED`` / ``TPUDL_SERVE_PAGE_SIZE`` /
 ``TPUDL_SERVE_KV_DTYPE`` (paged KV layout + optional int8 storage for
-``from_model`` — see tpudl.serve.cache.PagedKVCache).
+``from_model`` — see tpudl.serve.cache.PagedKVCache),
+``TPUDL_SERVE_PREFIX_SHARE`` (radix prefix-sharing KV — COW page
+sharing + chunked suffix prefill), ``TPUDL_SERVE_SPEC_K``
+(speculative decoding window; 0/unset = off — see
+tpudl.serve.speculate).
 
 Streaming: ``session.stream(requests)`` yields ``StreamChunk``s as
 tokens are selected (the router's per-request streaming feed) instead
@@ -146,6 +150,21 @@ def validate_request(request: Request, prompt_len: int, max_seq_len: int) -> Non
         )
 
 
+def _find_pool(tree) -> Optional[dict]:
+    """First per-layer page-pool dict in a paged cache pytree (the
+    artifact-geometry probe ``from_artifacts`` reads shapes off)."""
+    from collections.abc import Mapping
+
+    if isinstance(tree, Mapping):
+        if "pages_k" in tree:
+            return dict(tree)
+        for value in tree.values():
+            found = _find_pool(value)
+            if found is not None:
+                return found
+    return None
+
+
 def _env_int(name: str, default: int) -> int:
     raw = os.environ.get(name)
     if not raw:
@@ -174,6 +193,9 @@ class ServeSession:
         continuous: bool = True,
         slo=None,
         cache=None,
+        chunk_prefill_call: Optional[Callable] = None,
+        speculator=None,
+        verify_call: Optional[Callable] = None,
     ):
         # Deferred import: engine imports Request/Result from this
         # module.
@@ -195,6 +217,8 @@ class ServeSession:
         self.engine = Engine(
             prefill_call, decode_call, params, cache, self.queue,
             prompt_len, clock=clock, continuous=continuous,
+            chunk_prefill_call=chunk_prefill_call,
+            speculator=speculator, verify_call=verify_call,
         )
         if slo is not None:
             # A tpudl.obs.slo.SloMonitor: the engine feeds it
@@ -224,12 +248,36 @@ class ServeSession:
         kv_dtype: Optional[str] = None,
         num_pages: Optional[int] = None,
         weight_dtype: Optional[str] = None,
+        prefix_share: Optional[bool] = None,
+        spec_k: Optional[int] = None,
+        draft_weight_dtype: str = "int8",
+        draft_model=None,
+        draft_params=None,
         **kwargs,
     ) -> "ServeSession":
         """Live-model session: jit the prefill/decode contracts (batch 1
         and batch ``num_slots`` respectively) and derive the cache
         template by abstract evaluation — nothing compiles until the
         first request.
+
+        ``prefix_share=True`` (or ``TPUDL_SERVE_PREFIX_SHARE=1``;
+        requires ``paged``) turns on the radix prefix cache: seating
+        walks a tree of page-granular token-block hashes, maps every
+        matched full page into the new slot's table copy-on-write for
+        free, and prefills only the unshared suffix through the
+        chunked prefill program — a shared system prompt is prefilled
+        once per replica, then TTFT is O(unshared suffix) and resident
+        capacity multiplies on top of int8 KV.
+
+        ``spec_k=K`` (or ``TPUDL_SERVE_SPEC_K``; requires ``paged``)
+        turns on speculative decoding: a DRAFT path proposes K tokens
+        per slot (default: a quantized self-draft built by
+        ``tpudl.quant`` at ``draft_weight_dtype``; pass
+        ``draft_model``/``draft_params`` for a small companion model)
+        and the target verifies the window in one slot-batched chunk
+        dispatch — acceptance keeps the output distribution
+        (tpudl.serve.speculate), gated by ``assert_serving_parity``'s
+        teacher-forced margin mode.
 
         ``paged=True`` (or ``TPUDL_SERVE_PAGED=1``) swaps the dense
         fixed-slot cache for the paged layout (per-slot page tables, no
@@ -251,7 +299,9 @@ class ServeSession:
         ``assert_serving_parity(..., atol=...)`` vs the full-precision
         model, same as the quantized-KV tier."""
         from tpudl.models.generate import (
+            chunk_prefill_fn,
             decode_fn,
+            paged_chunk_decode_fn,
             paged_decode_fn,
             prefill_fn,
         )
@@ -275,9 +325,21 @@ class ServeSession:
             paged = os.environ.get("TPUDL_SERVE_PAGED", "") in (
                 "1", "true", "yes"
             )
+        if prefix_share is None:
+            prefix_share = os.environ.get(
+                "TPUDL_SERVE_PREFIX_SHARE", ""
+            ) in ("1", "true", "yes")
+        if spec_k is None:
+            raw = os.environ.get("TPUDL_SERVE_SPEC_K")
+            spec_k = int(raw) if raw else None
+            if spec_k == 0:
+                spec_k = None
         pf = prefill_fn(model)
         ids = jax.ShapeDtypeStruct((num_slots, prompt_len), jnp.int32)
         _, cache_template = jax.eval_shape(pf, params, ids, ids)
+        chunk_prefill = None
+        speculator = None
+        verify = None
         if paged:
             from tpudl.serve.cache import PagedKVCache
 
@@ -292,22 +354,75 @@ class ServeSession:
                 ),
                 num_pages=num_pages,
                 kv_dtype=kv_dtype,
+                prefix_share=bool(prefix_share),
             )
             decode = jax.jit(
                 paged_decode_fn(model, cache.page_size, cache.quantized)
             )
+            if prefix_share:
+                chunk_prefill = jax.jit(chunk_prefill_fn(model))
+            if spec_k:
+                from tpudl.quant import quantize_model, weight_bytes_report
+                from tpudl.serve.speculate import Speculator
+
+                if draft_model is None:
+                    # Quantized SELF-draft: same architecture, low-
+                    # precision weights — agrees with the target on
+                    # almost every greedy token at a fraction of the
+                    # bytes/dispatch.
+                    draft_model, draft_params = quantize_model(
+                        model, params, draft_weight_dtype
+                    )
+                elif draft_params is None:
+                    raise ValueError(
+                        "draft_model needs draft_params"
+                    )
+                # The draft's OWN cache template: a companion model's
+                # KV geometry (layers, kv-heads, head-dim) need not
+                # match the target's — only the tokenizer must.
+                _, draft_template = jax.eval_shape(
+                    prefill_fn(draft_model), draft_params, ids, ids
+                )
+                draft_cache = PagedKVCache(
+                    draft_template,
+                    page_size=cache.page_size,
+                    num_pages=num_pages,
+                )
+                speculator = Speculator(
+                    jax.jit(prefill_fn(draft_model)),
+                    jax.jit(paged_decode_fn(
+                        draft_model, draft_cache.page_size, False
+                    )),
+                    draft_params,
+                    draft_cache,
+                    k=spec_k,
+                    weight_bytes=weight_bytes_report(
+                        draft_params
+                    )["total_bytes"],
+                )
+                verify = jax.jit(paged_chunk_decode_fn(
+                    model, cache.page_size, cache.quantized
+                ))
         elif page_size is not None or kv_dtype is not None or (
             num_pages is not None
         ):
             raise ValueError(
                 "page_size/kv_dtype/num_pages require paged=True"
             )
+        elif prefix_share or spec_k:
+            raise ValueError(
+                "prefix_share/spec_k require paged=True (per-slot page "
+                "tables are what make COW sharing and window rollback "
+                "possible)"
+            )
         else:
             cache = None
             decode = jax.jit(decode_fn(model))
         return cls(
             jax.jit(pf), decode, params,
-            cache_template, prompt_len, cache=cache, **kwargs,
+            cache_template, prompt_len, cache=cache,
+            chunk_prefill_call=chunk_prefill, speculator=speculator,
+            verify_call=verify, **kwargs,
         )
 
     @classmethod
@@ -316,11 +431,21 @@ class ServeSession:
         prefill_blob_or_path,
         decode_blob_or_path,
         params,
+        paged: Optional[bool] = None,
         **kwargs,
     ) -> "ServeSession":
         """Artifact session: every engine shape is recovered from the
         deserialized programs — slot count and cache bound from the
-        decode input avals, prompt window from the prefill's."""
+        decode input avals, prompt window from the prefill's.
+
+        A PAGED decode artifact (exported with
+        ``export_serving_decoder(..., paged=True)``) is auto-detected
+        by its extra addressing inputs; page size, pool size, per-slot
+        page span, and int8 quantization are all recovered from the
+        pool/page-table avals, so the paged-KV contract round-trips
+        through StableHLO with no side-channel metadata. ``paged``
+        (optional) asserts the expectation — a mismatch raises instead
+        of serving the wrong layout."""
         from tpudl.export.export import load_exported_obj
 
         pre = load_exported_obj(prefill_blob_or_path)
@@ -328,7 +453,12 @@ class ServeSession:
         (pre_args, _) = jax.tree.unflatten(pre.in_tree, pre.in_avals)
         (dec_args, _) = jax.tree.unflatten(dec.in_tree, dec.in_avals)
         _, ids_aval, _ = pre_args
-        _, cache_template, token_aval, _ = dec_args
+        is_paged = len(dec_args) == 7
+        if paged is not None and bool(paged) != is_paged:
+            raise ValueError(
+                f"decode artifact is {'paged' if is_paged else 'dense'} "
+                f"but paged={paged} was requested"
+            )
         if ids_aval.shape[0] != 1:
             raise ValueError(
                 f"serving prefill artifact must be batch-1 (one request "
@@ -336,9 +466,47 @@ class ServeSession:
                 f"export with tpudl.export.decode.export_serving_decoder"
             )
         prompt_len = int(ids_aval.shape[1])
+        cache = None
+        if is_paged:
+            from tpudl.serve.cache import PagedKVCache
+
+            _, cache_template, token_aval, _, table_aval, _, _ = dec_args
+            pool = _find_pool(cache_template)
+            if pool is None:
+                raise ValueError(
+                    "paged decode artifact carries no page-pool cache "
+                    "(no pages_k leaf in its cache avals)"
+                )
+            # The model's compiled sequence bound lives in the PREFILL
+            # artifact's dense row-cache outputs ([1, max_seq_len]
+            # validity rows): when page_size does not divide it, the
+            # page span rounds past the model's position space and the
+            # cache must clamp admission exactly like the live path.
+            _, pre_cache = jax.tree.unflatten(pre.out_tree, pre.out_avals)
+            from tpudl.serve.cache import _is_valid_leaf
+
+            model_bound = next(
+                (
+                    int(leaf.shape[1])
+                    for leaf in jax.tree.leaves(pre_cache)
+                    if _is_valid_leaf(leaf)
+                ),
+                None,
+            )
+            cache = PagedKVCache.from_pool_template(
+                cache_template,
+                num_slots=int(token_aval.shape[0]),
+                pages_per_slot=int(table_aval.shape[1]),
+                page_size=int(pool["pages_k"].shape[1]),
+                quantized="scale_k" in pool,
+                num_pages=int(pool["pages_k"].shape[0]),
+                model_seq_len=model_bound,
+            )
+        else:
+            _, cache_template, token_aval, _ = dec_args
         session = cls(
             pre.call, dec.call, params, cache_template, prompt_len,
-            **kwargs,
+            cache=cache, **kwargs,
         )
         if session.num_slots != int(token_aval.shape[0]):
             raise ValueError(

@@ -29,7 +29,8 @@ batch-independent, so a refill is bit-invisible to the other slots
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -232,6 +233,309 @@ def _zip_attn_caches(a, b, fn):
     return a
 
 
+# ---------------------------------------------------------------------------
+# Radix prefix tree (copy-on-write page sharing)
+# ---------------------------------------------------------------------------
+
+
+def block_hash(block: Tuple[int, ...]) -> int:
+    """Child-index key for one page-sized token block. Module-level so
+    tests can monkeypatch it into collisions: the tree NEVER trusts the
+    hash alone — every lookup re-compares the full token tuple."""
+    return hash(block)
+
+
+class _RadixNode:
+    """One compressed radix-tree edge: a run of page-sized token blocks
+    and the physical pages holding their KV, parallel lists. A lease
+    (one seated slot mapping through this node) increments ``refcount``
+    on the node AND every ancestor, so ``refcount == 0`` implies the
+    whole subtree is lease-free — the eviction-safety invariant."""
+
+    __slots__ = (
+        "blocks", "pages", "children", "parent", "refcount", "stamp",
+    )
+
+    def __init__(self, blocks, pages, parent):
+        self.blocks: List[Tuple[int, ...]] = blocks
+        self.pages: List[int] = pages
+        #: hash(first block) -> [nodes]. A LIST per hash: collisions
+        #: resolve by comparing the stored block tuples, never the
+        #: hash alone.
+        self.children: Dict[int, List["_RadixNode"]] = {}
+        self.parent: Optional["_RadixNode"] = parent
+        self.refcount = 0
+        self.stamp = 0  # LRU recency (tree._clock at last touch)
+
+
+class RadixPrefixTree:
+    """Prefix index over page-granular token blocks -> physical KV
+    pages (the vLLM/SGLang RadixAttention idea on tpudl's paged
+    substrate). ``match_and_lease`` walks a prompt's full token blocks
+    down the tree, SPLITTING a partially-matched compressed edge at the
+    divergence point (the COW-split: the shared prefix half keeps the
+    shared pages, both continuations hang under it), pins every matched
+    node with a refcount lease, and hands back the matched pages —
+    which the seat maps into the new slot's page table FOR FREE.
+    ``insert_suffix`` registers the freshly-prefilled full blocks so
+    later requests hit them. Releasing a lease (slot freed) does NOT
+    free the pages: refcount-0 nodes stay cached and become the
+    EVICTABLE pool, reclaimed leaf-first in LRU order under page
+    pressure (``evict``).
+
+    Thread model: the owning engine thread is the only mutator; the
+    router's prefix-affinity probe calls ``match_len`` concurrently,
+    so every public method takes the internal lock. Scans are O(tree)
+    — prefix trees here index a handful of system prompts, not the
+    token universe; keep it simple until a bench says otherwise."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self.root = _RadixNode([], [], None)
+        self._lock = threading.RLock()
+        self._clock = 0
+        #: Pages in refcount-0 nodes — reclaimable without touching any
+        #: live slot (maintained incrementally by lease/release).
+        self.evictable_pages = 0
+        #: Pages held by the tree in total (leased + evictable).
+        self.cached_pages = 0
+        self.num_splits = 0
+        self.num_evictions = 0
+
+    # -- block helpers --------------------------------------------------
+
+    def blocks_of(self, tokens) -> List[Tuple[int, ...]]:
+        """The FULL page-sized token blocks of a prompt (the sharable
+        granularity; a trailing partial block is always private)."""
+        ps = self.page_size
+        n = len(tokens) // ps
+        return [tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+                for i in range(n)]
+
+    def _child(self, node: _RadixNode, block) -> Optional[_RadixNode]:
+        for cand in node.children.get(block_hash(block), ()):
+            # Full token-block compare: a hash collision must select by
+            # VALUE or two different prompts would share wrong KV.
+            if cand.blocks[0] == block:
+                return cand
+        return None
+
+    def _attach(self, parent: _RadixNode, node: _RadixNode) -> None:
+        node.parent = parent
+        parent.children.setdefault(block_hash(node.blocks[0]), []).append(
+            node
+        )
+
+    def _detach(self, node: _RadixNode) -> None:
+        key = block_hash(node.blocks[0])
+        siblings = node.parent.children.get(key, [])
+        siblings.remove(node)
+        if not siblings:
+            del node.parent.children[key]
+
+    # -- queries --------------------------------------------------------
+
+    def match_len(self, tokens) -> int:
+        """Longest cached prefix of ``tokens`` in TOKENS (page-granular;
+        read-only — the router's prefix-affinity probe)."""
+        return self.match_info(tokens)[0]
+
+    def match_info(self, tokens) -> Tuple[int, int]:
+        """``(matched_tokens, matched_evictable_pages)`` — the second
+        number counts matched pages currently sitting in the EVICTABLE
+        pool (refcount 0). Admission needs it: seating pins those
+        pages, so they cannot also satisfy the request's remaining
+        allocation — counting them both as "mapped for free" and as
+        "reclaimable" would admit work the seat cannot place."""
+        with self._lock:
+            blocks = self.blocks_of(tokens)
+            node, i = self.root, 0
+            evictable = 0
+            while i < len(blocks):
+                child = self._child(node, blocks[i])
+                if child is None:
+                    break
+                j = 0
+                while (
+                    j < len(child.blocks)
+                    and i + j < len(blocks)
+                    and child.blocks[j] == blocks[i + j]
+                ):
+                    j += 1
+                if j and child.refcount == 0:
+                    # A partial match splits at lease time; the matched
+                    # half inherits this refcount, so counting its j
+                    # pages is exact.
+                    evictable += j
+                i += j
+                if j < len(child.blocks):
+                    break
+                node = child
+            return i * self.page_size, evictable
+
+    # -- lease lifecycle ------------------------------------------------
+    #
+    # A lease is represented by its DEEPEST node; acquire/release walk
+    # the ancestor path. That makes COW-splits lease-transparent: the
+    # split copies the node's refcount onto the new upper half (every
+    # lease through the node also covers its prefix), and a later
+    # release's root-walk decrements both halves exactly once.
+
+    def _acquire_path(self, node: _RadixNode) -> None:
+        self._clock += 1
+        while node is not None and node is not self.root:
+            if node.refcount == 0:
+                self.evictable_pages -= len(node.pages)
+            node.refcount += 1
+            node.stamp = self._clock
+            node = node.parent
+
+    def release(self, lease: Optional[_RadixNode]) -> None:
+        """Drop one seat's pin (``lease`` = the deepest node
+        ``match_and_lease``/``insert_suffix`` handed out). Refcount-0
+        nodes stay CACHED — their pages join the evictable pool, freed
+        only by LRU eviction under pressure."""
+        if lease is None:
+            return
+        with self._lock:
+            node = lease
+            while node is not None and node is not self.root:
+                node.refcount -= 1
+                assert node.refcount >= 0, "radix lease released twice"
+                if node.refcount == 0:
+                    self.evictable_pages += len(node.pages)
+                node = node.parent
+
+    def match_and_lease(self, tokens):
+        """Walk ``tokens``'s full blocks, splitting a partially-matched
+        edge at the divergence, and LEASE the matched path. Returns
+        ``(matched_pages, deepest_node_or_None)``; the caller owns the
+        lease and must ``release`` it exactly once
+        (``PagedKVCache.free`` does, per seated slot)."""
+        with self._lock:
+            blocks = self.blocks_of(tokens)
+            node, i = self.root, 0
+            pages: List[int] = []
+            while i < len(blocks):
+                child = self._child(node, blocks[i])
+                if child is None:
+                    break
+                j = 0
+                while (
+                    j < len(child.blocks)
+                    and i + j < len(blocks)
+                    and child.blocks[j] == blocks[i + j]
+                ):
+                    j += 1
+                if j == 0:
+                    break
+                if j < len(child.blocks):
+                    # Divergence (or prompt end) inside the compressed
+                    # edge: split so the matched half is its own node —
+                    # leases and eviction then stay whole-node.
+                    child = self._split_at(child, j)
+                pages.extend(child.pages)
+                i += j
+                node = child
+            if node is self.root:
+                return pages, None
+            self._acquire_path(node)
+            return pages, node
+
+    def _split_at(self, node: _RadixNode, j: int) -> _RadixNode:
+        """COW-split a compressed edge at block ``j``: blocks[:j] become
+        a new (shared) parent keeping those pages, blocks[j:] stay on
+        ``node``, re-hung underneath. Refcount/stamp copy to the new
+        parent — every lease through ``node`` also covers its prefix,
+        so the path invariant (ancestor refcount >= descendant) holds."""
+        upper = _RadixNode(node.blocks[:j], node.pages[:j], None)
+        upper.refcount = node.refcount
+        upper.stamp = node.stamp
+        parent = node.parent
+        self._detach(node)
+        self._attach(parent, upper)
+        node.blocks = node.blocks[j:]
+        node.pages = node.pages[j:]
+        self._attach(upper, node)
+        self.num_splits += 1
+        return upper
+
+    def insert_suffix(self, parent, blocks, pages):
+        """Register freshly-prefilled full blocks under ``parent`` (the
+        deepest matched node, or None for the root): the tree takes
+        OWNERSHIP of those pages (they return to the pool only via
+        eviction). The new node is born refcount-1 — it extends the
+        seating slot's lease, whose ancestors were already pinned by
+        ``match_and_lease`` — and becomes the lease's deepest node.
+        Returns None when there is nothing to insert (the caller keeps
+        the match lease as-is)."""
+        if not blocks:
+            return None
+        assert len(blocks) == len(pages)
+        with self._lock:
+            node = _RadixNode(list(blocks), list(pages), None)
+            self._attach(parent if parent is not None else self.root, node)
+            self.cached_pages += len(pages)
+            node.refcount = 1  # pinned by the seating slot from birth
+            self._clock += 1
+            node.stamp = self._clock
+            return node
+
+    # -- eviction -------------------------------------------------------
+
+    def _evictable_leaves(self) -> List[_RadixNode]:
+        out: List[_RadixNode] = []
+
+        def walk(node: _RadixNode) -> None:
+            for cands in node.children.values():
+                for child in cands:
+                    walk(child)
+            if node is not self.root and node.refcount == 0 and (
+                not node.children
+            ):
+                out.append(node)
+
+        walk(self.root)
+        return out
+
+    def evict(self, need_pages: int) -> List[int]:
+        """Reclaim up to ``need_pages`` pages by evicting refcount-0
+        LEAF nodes oldest-stamp-first (leaf-first keeps the tree
+        consistent: an interior node only becomes a leaf once its
+        subtree is gone, and refcount-0 guarantees no lease is
+        anywhere below). Returns the freed page ids."""
+        freed: List[int] = []
+        with self._lock:
+            while len(freed) < need_pages:
+                leaves = self._evictable_leaves()
+                if not leaves:
+                    break
+                victim = min(leaves, key=lambda n: n.stamp)
+                self._detach(victim)
+                freed.extend(victim.pages)
+                self.cached_pages -= len(victim.pages)
+                self.evictable_pages -= len(victim.pages)
+                self.num_evictions += 1
+        return freed
+
+    def stats(self) -> dict:
+        with self._lock:
+            n_nodes = 0
+            stack = [self.root]
+            while stack:
+                node = stack.pop()
+                n_nodes += 1
+                for cands in node.children.values():
+                    stack.extend(cands)
+            return {
+                "nodes": n_nodes - 1,  # excluding the root
+                "cached_pages": self.cached_pages,
+                "evictable_pages": self.evictable_pages,
+                "splits": self.num_splits,
+                "evictions": self.num_evictions,
+            }
+
+
 class PagedKVCache:
     """Paged + optionally int8-quantized successor to ``SlotCache``.
 
@@ -260,6 +564,14 @@ class PagedKVCache:
     per-slot start/len) is host-side numpy, shipped into each decode
     dispatch as small traced inputs — seating and freeing never
     recompile anything.
+
+    ``prefix_share=True`` adds the RADIX layer (``RadixPrefixTree``):
+    seating goes LEFT-ALIGNED through ``seat_shared`` — token ``i`` at
+    logical position ``i``, so identical token prefixes are
+    page-identical — matched full pages map copy-on-write for free,
+    freed prompts stay CACHED (evictable at refcount 0, reclaimed LRU
+    leaf-first under pressure), and ``gather_prefix_rows`` turns a
+    cached prefix back into dense rows for the chunked suffix prefill.
     """
 
     #: Marks the paged engine path (Engine branches on this).
@@ -272,6 +584,7 @@ class PagedKVCache:
         num_pages: Optional[int] = None,
         kv_dtype: Optional[str] = None,
         max_target_len: Optional[int] = None,
+        prefix_share: bool = False,
     ):
         import numpy as np
 
@@ -351,6 +664,87 @@ class PagedKVCache:
         self.start = np.zeros((self.num_slots,), np.int32)
         self.lens = np.zeros((self.num_slots,), np.int32)
         self._seat_jit = {}
+        # Prefix sharing (radix mode): seating is LEFT-ALIGNED (token i
+        # of every prompt lives at logical position i, start == 0), so
+        # identical token prefixes land on identical page-aligned
+        # content and the radix tree can map them for free. The dense
+        # row template is kept for gather_prefix_rows (pages -> dense
+        # prefix rows for the chunked suffix prefill).
+        self.prefix_share = bool(prefix_share)
+        self.radix: Optional[RadixPrefixTree] = None
+        self._leases: dict = {}
+        self._row_template = None
+        self._seat_shared_fn = None
+        self._gather_rows_fn = None
+        if self.prefix_share:
+            self.radix = RadixPrefixTree(self.page_size)
+            self._row_template = jax.tree.map(
+                lambda leaf: jax.ShapeDtypeStruct(
+                    leaf.shape if getattr(leaf, "ndim", 0) == 0
+                    else (1,) + tuple(leaf.shape[1:]),
+                    leaf.dtype,
+                ),
+                template,
+                is_leaf=lambda x: hasattr(x, "shape"),
+            )
+
+    @classmethod
+    def from_pool_template(
+        cls,
+        pools: Any,
+        num_slots: int,
+        pages_per_slot: int,
+        page_size: int,
+        quantized: bool,
+        num_pages: int,
+        model_seq_len: Optional[int] = None,
+    ) -> "PagedKVCache":
+        """Build a paged cache straight from a POOL pytree (the decode
+        artifact's cache input avals) — the exported-artifact session's
+        constructor, where no dense template exists. Every geometry
+        fact is recovered from the artifact's own shapes
+        (``ServeSession.from_artifacts``). ``model_seq_len`` is the
+        exporting model's compiled sequence bound (read off the
+        prefill artifact's dense cache rows): when ``page_size`` does
+        not divide it, the page span rounds up past positions the
+        model's position space actually has, and the ``max_seq_len``
+        clamp must keep admission from seating work there — the same
+        clamp the live constructor applies. Prefix sharing needs the
+        live chunked prefill program, so it stays a from_model-only
+        feature."""
+        import numpy as np
+
+        obj = cls.__new__(cls)
+        obj.num_slots = int(num_slots)
+        obj.page_size = int(page_size)
+        obj.quantized = bool(quantized)
+        obj.pages_per_slot = int(pages_per_slot)
+        obj.model_seq_len = int(
+            model_seq_len
+            if model_seq_len is not None
+            else obj.pages_per_slot * obj.page_size
+        )
+        obj.num_pages = int(num_pages)
+        obj.cache = jax.tree.map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype),
+            pools,
+            is_leaf=lambda x: hasattr(x, "shape"),
+        )
+        obj._free = list(range(1, obj.num_pages))
+        obj._reserved = {}
+        obj.page_table = np.zeros(
+            (obj.num_slots, obj.pages_per_slot), np.int32
+        )
+        obj.start = np.zeros((obj.num_slots,), np.int32)
+        obj.lens = np.zeros((obj.num_slots,), np.int32)
+        obj._seat_jit = {}
+        obj.prefix_share = False
+        obj.radix = None
+        obj._leases = {}
+        obj._row_template = None
+        obj._seat_shared_fn = None
+        obj._gather_rows_fn = None
+        return obj
 
     # -- capacity ------------------------------------------------------
 
@@ -370,11 +764,45 @@ class PagedKVCache:
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def available_pages(self) -> int:
+        """Pages seatable right now: the free pool plus (radix mode)
+        refcount-0 tree pages, which eviction reclaims without touching
+        any live slot."""
+        extra = self.radix.evictable_pages if self.radix is not None else 0
+        return len(self._free) + extra
+
     def fits_tokens(self, tokens: int) -> bool:
         """Admission predicate: can a request that may write ``tokens``
         logical positions be seated right now? Reservation up front
-        means yes here == never strands mid-decode."""
-        return self.pages_needed(tokens) <= len(self._free)
+        means yes here == never strands mid-decode. Radix sessions use
+        ``fits_request`` instead — it credits the cached prefix."""
+        return self.pages_needed(tokens) <= self.available_pages
+
+    def fits_request(self, input_ids, tokens: int) -> bool:
+        """Radix-mode admission: matched prefix pages map for free, so
+        only the unshared remainder counts against the pool — sharing
+        COMPOUNDS with int8 KV's resident-slot multiplier. Matched
+        pages that are currently refcount-0 get PINNED by the seat, so
+        they are excluded from the reclaimable side (counting them both
+        as free-to-map and as evictable would admit a request
+        ``seat_shared`` cannot place — the reservation invariant)."""
+        if self.radix is None:
+            return self.fits_tokens(tokens)
+        matched, matched_evictable = self.radix.match_info(input_ids)
+        need = self.pages_needed(tokens) - matched // self.page_size
+        avail = len(self._free) + (
+            self.radix.evictable_pages - matched_evictable
+        )
+        return need <= avail
+
+    def prefix_match_len(self, input_ids) -> int:
+        """Cached-prefix length (tokens) for a prompt — 0 when prefix
+        sharing is off. Read-only (the router's affinity probe calls
+        this from its own thread)."""
+        if self.radix is None:
+            return 0
+        return self.radix.match_len(input_ids)
 
     # -- seating / freeing ---------------------------------------------
 
@@ -391,6 +819,12 @@ class PagedKVCache:
         (``[0, prompt_len)``, quantizing if int8) into the first pages.
         ``pad`` is the row's left-pad count — logical positions below
         it stay masked, exactly like dense validity."""
+        if self.prefix_share:
+            raise ValueError(
+                "prefix-share caches seat left-aligned via seat_shared "
+                "(pad-aligned seat would break the radix tree's "
+                "canonical token->logical-position mapping)"
+            )
         if not 0 <= slot < self.num_slots:
             raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
         if slot in self._reserved:
@@ -468,11 +902,226 @@ class PagedKVCache:
 
         return seat
 
-    def free(self, slot: int) -> None:
-        """Return the slot's pages to the pool and point its table row
-        at the trash page (idle ride-along writes land there)."""
+    # -- prefix-sharing (radix) seating ---------------------------------
+
+    def match_and_lease(self, input_ids):
+        """Radix walk + lease for one prompt (engine seat path): the
+        matched pages map into the slot's table for free; the lease
+        pins them until ``free``/``release_lease``. See
+        ``RadixPrefixTree.match_and_lease``."""
+        if self.radix is None:
+            raise ValueError("match_and_lease requires prefix_share=True")
+        return self.radix.match_and_lease(input_ids)
+
+    def release_lease(self, lease) -> None:
+        """Failure-path unpin (a lease whose seat never completed)."""
+        if lease is not None:
+            self.radix.release(lease)
+
+    def _alloc_pages(self, n: int) -> list:
+        """Pop ``n`` pages from the free pool, evicting LRU refcount-0
+        radix nodes when the pool alone is short — the under-pressure
+        path ``fits_tokens``'s ``available_pages`` promised."""
+        if n > len(self._free) and self.radix is not None:
+            self._free.extend(self.radix.evict(n - len(self._free)))
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: need {n} pages, {len(self._free)} "
+                f"free (admission should have checked fits_tokens)"
+            )
+        return [self._free.pop() for _ in range(n)]
+
+    def seat_shared(
+        self,
+        row_cache: Any,
+        slot: int,
+        input_ids,
+        reserve_tokens: int,
+        lease=None,
+        row_offset: int = 0,
+    ) -> None:
+        """LEFT-ALIGNED radix seating: token ``i`` of the prompt lives
+        at logical position ``i`` (start 0) so identical prefixes are
+        page-identical across requests. ``lease`` is the
+        ``match_and_lease`` result whose pages map into the table for
+        free; only the UNSHARED remainder allocates (evicting LRU
+        cached pages under pressure), and only the unshared suffix of
+        ``row_cache`` is scattered — shared pages are never rewritten
+        (copy-on-write: decode writes land at ``lens >= ids_len``,
+        always in private pages). ``row_offset`` names where the
+        prompt's first token sits in the dense row (its left-pad count
+        for a full-prefill row; 0 for a chunk-prefill row). The
+        prompt's freshly written FULL pages are inserted into the tree
+        so later requests hit them."""
+        import numpy as np
+
+        ids = np.asarray(input_ids, np.int32)
+        ids_len = int(ids.shape[0])
         if not 0 <= slot < self.num_slots:
             raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
+        if slot in self._reserved or slot in self._leases:
+            raise ValueError(f"slot {slot} is already seated")
+        matched_pages, deepest = lease if lease is not None else ([], None)
+        m = len(matched_pages)
+        try:
+            if reserve_tokens > self.max_seq_len:
+                raise ValueError(
+                    f"reserve_tokens {reserve_tokens} exceeds the logical "
+                    f"per-slot bound {self.max_seq_len}"
+                )
+            assert m * self.page_size <= ids_len, (
+                "lease longer than the prompt — matched against the "
+                "wrong request"
+            )
+            new_pages = self._alloc_pages(self.pages_needed(reserve_tokens) - m)
+        except BaseException:
+            self.release_lease(deepest)
+            raise
+        prompt_pages = self.pages_needed(ids_len)
+        full = ids_len // self.page_size
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :m] = matched_pages
+        self.page_table[slot, m:m + len(new_pages)] = new_pages
+        self.start[slot] = 0
+        self.lens[slot] = ids_len
+        # Scatter ONLY the unshared pages [m, prompt_pages); matched
+        # pages keep their (identical) bytes untouched and page ids
+        # outside that range aim at the trash page.
+        page_ids = np.zeros((self.pages_per_slot,), np.int32)
+        page_ids[m:prompt_pages] = new_pages[: prompt_pages - m]
+        if self._seat_shared_fn is None:
+            self._seat_shared_fn = jax.jit(self._make_seat_shared_fn())
+        self.cache = self._seat_shared_fn(
+            self.cache, row_cache, jnp.asarray(page_ids),
+            jnp.int32(row_offset),
+        )
+        # The prompt's full pages enter the tree (tree-owned: they go
+        # back to the pool only via eviction); the partial tail +
+        # decode-reserve pages stay private to the slot.
+        node = self.radix.insert_suffix(
+            deepest,
+            self.radix.blocks_of(ids)[m:full],
+            new_pages[: full - m],
+        )
+        final = node if node is not None else deepest
+        if final is not None:
+            self._leases[slot] = final
+        self._reserved[slot] = new_pages[full - m:]
+
+    def _make_seat_shared_fn(self):
+        """The one jitted left-aligned scatter (all requests, any match
+        length): the dense row is sliced from ``row_offset``, re-laid
+        as pages, and written at ``page_ids`` — entries pinned to 0
+        land in the trash page, which is how matched-prefix pages and
+        the unused tail are skipped without a second program."""
+        from tpudl.models.paged import quantize_kv
+
+        ps, quantized = self.page_size, self.quantized
+        pages = self.pages_per_slot
+        span = pages * ps
+
+        def seat(pool_tree, row_tree, page_ids, row_offset):
+            def one(pool: dict, row: dict) -> dict:
+                out = dict(pool)
+                for kv, name, sname in (
+                    ("k", "pages_k", "scale_k"),
+                    ("v", "pages_v", "scale_v"),
+                ):
+                    rowvals = row[kv][0]
+                    padded = jnp.pad(
+                        rowvals,
+                        [(0, span)] + [(0, 0)] * (rowvals.ndim - 1),
+                    )
+                    blocks = jax.lax.dynamic_slice_in_dim(
+                        padded, row_offset, span, axis=0
+                    ).reshape(pages, ps, *rowvals.shape[1:])
+                    if quantized:
+                        q, s = quantize_kv(blocks)
+                        out[name] = out[name].at[page_ids].set(q)
+                        out[sname] = out[sname].at[page_ids].set(s)
+                    else:
+                        out[name] = out[name].at[page_ids].set(
+                            blocks.astype(out[name].dtype)
+                        )
+                return out
+
+            return _zip_attn_caches(pool_tree, row_tree, one)
+
+        return seat
+
+    def gather_prefix_rows(self, matched_pages, matched_tokens: int):
+        """Materialize a leased prefix into a batch-1 DENSE row cache
+        (k/v rows [0, matched_tokens), validity set, index pinned) —
+        the input the chunked suffix prefill resumes from. One jitted
+        program for every match length (page ids ride in padded)."""
+        import numpy as np
+
+        if self._row_template is None:
+            raise ValueError(
+                "gather_prefix_rows requires prefix_share=True (needs "
+                "the dense row template)"
+            )
+        if self._gather_rows_fn is None:
+            self._gather_rows_fn = jax.jit(self._make_gather_rows_fn())
+        page_ids = np.zeros((self.pages_per_slot,), np.int32)
+        page_ids[: len(matched_pages)] = matched_pages
+        return self._gather_rows_fn(
+            self.cache, jnp.asarray(page_ids), jnp.int32(matched_tokens)
+        )
+
+    def _make_gather_rows_fn(self):
+        ps, quantized = self.page_size, self.quantized
+        span = self.pages_per_slot * ps
+        row_template = self._row_template
+
+        def gather(pool_tree, page_ids, m_tok):
+            def one(pool: dict, tmpl: dict) -> dict:
+                seq = int(tmpl["k"].shape[1])
+                flat_idx = (
+                    page_ids[:, None] * ps
+                    + jnp.arange(ps, dtype=page_ids.dtype)[None, :]
+                ).reshape(-1)
+                out = {}
+                for kv, name, sname in (
+                    ("k", "pages_k", "scale_k"),
+                    ("v", "pages_v", "scale_v"),
+                ):
+                    pool_arr = pool[name]
+                    flat = pool_arr.reshape(
+                        pool_arr.shape[0] * ps, *pool_arr.shape[2:]
+                    )
+                    rows = flat[flat_idx]
+                    if quantized:
+                        sc = pool[sname].reshape(-1, pool[sname].shape[2])
+                        rows = rows.astype(jnp.float32) * (
+                            sc[flat_idx][..., None]
+                        )
+                    if span >= seq:
+                        rows = rows[:seq]
+                    else:
+                        rows = jnp.pad(
+                            rows,
+                            [(0, seq - span)] + [(0, 0)] * (rows.ndim - 1),
+                        )
+                    out[kv] = rows[None].astype(tmpl[kv].dtype)
+                out["valid"] = (jnp.arange(seq) < m_tok)[None, :]
+                out["index"] = jnp.asarray(m_tok, tmpl["index"].dtype)
+                return out
+
+            return _zip_attn_caches(pool_tree, row_template, one)
+
+        return gather
+
+    def free(self, slot: int) -> None:
+        """Return the slot's PRIVATE pages to the pool, release its
+        radix lease (shared pages stay cached in the tree, evictable
+        once their refcount drops to 0), and point its table row at the
+        trash page (idle ride-along writes land there)."""
+        if not 0 <= slot < self.num_slots:
+            raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
+        lease = self._leases.pop(slot, None)
+        if lease is not None and self.radix is not None:
+            self.radix.release(lease)
         pages = self._reserved.pop(slot, None)
         if pages:
             self._free.extend(pages)
@@ -481,9 +1130,17 @@ class PagedKVCache:
         self.lens[slot] = 0
 
     def reset(self) -> None:
-        """Free every slot (the pool arrays keep their bytes — masked)."""
-        for slot in list(self._reserved):
+        """Free every slot (the pool arrays keep their bytes — masked).
+        Radix mode: the prefix cache SURVIVES a reset (cached prefixes
+        are the point); ``drop_prefix_cache`` clears it too."""
+        for slot in list(set(self._reserved) | set(self._leases)):
             self.free(slot)
+
+    def drop_prefix_cache(self) -> None:
+        """Evict every lease-free radix page back to the pool (after
+        ``reset``, that is the whole tree)."""
+        if self.radix is not None:
+            self._free.extend(self.radix.evict(self.radix.evictable_pages))
 
     # -- per-dispatch addressing ---------------------------------------
 
@@ -496,12 +1153,21 @@ class PagedKVCache:
             jnp.asarray(self.lens),
         )
 
-    def advance(self, slots) -> None:
+    def advance(self, slots, steps: int = 1) -> None:
         """Advance the logical length of each ACTIVE slot after a
-        decode dispatch wrote its token (idle slots stay pinned at 0 on
-        the trash page)."""
+        decode dispatch wrote its token(s) (idle slots stay pinned at 0
+        on the trash page). ``steps`` > 1 serves the speculative path's
+        per-slot window advance."""
         for slot in slots:
-            self.lens[slot] += 1
+            self.lens[slot] += steps
+
+    def set_len(self, slot: int, length: int) -> None:
+        """Pin one slot's logical length — the speculative ROLLBACK
+        primitive: a rejected proposal tail simply never advances lens,
+        so its page writes are masked garbage the next window
+        overwrites. Per-slot bookkeeping only (no shared write index
+        since the paged layout landed)."""
+        self.lens[slot] = int(length)
 
     # -- accounting ----------------------------------------------------
 

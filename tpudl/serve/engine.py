@@ -178,6 +178,9 @@ class Engine:
         prompt_len: int,
         clock: Callable[[], float] = time.monotonic,
         continuous: bool = True,
+        chunk_prefill_call: Optional[Callable] = None,
+        speculator=None,
+        verify_call: Optional[Callable] = None,
     ):
         if prompt_len < 1 or prompt_len >= cache.max_seq_len:
             raise ValueError(
@@ -195,6 +198,31 @@ class Engine:
         self.clock = clock
         self.continuous = continuous
         self.paged = bool(getattr(cache, "paged", False))
+        # Prefix sharing (radix mode, tpudl.serve.cache): seat walks
+        # the radix tree, maps matched full pages for free, and — with
+        # the chunked prefill program — prefills only the unshared
+        # suffix (the TTFT lever for shared system prompts). Without
+        # the chunk program (artifact sessions) sharing still
+        # deduplicates pages; only the compute skip is lost.
+        self.prefix_share = self.paged and bool(
+            getattr(cache, "prefix_share", False)
+        )
+        self.chunk_prefill_call = chunk_prefill_call
+        # Speculative decoding (tpudl.serve.speculate): draft k cheap
+        # tokens, verify them in ONE slot-batched chunk dispatch.
+        self.speculator = speculator
+        self.verify_call = verify_call
+        if speculator is not None:
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding requires a paged cache "
+                    "(per-slot lens is what makes rollback free)"
+                )
+            if verify_call is None:
+                raise ValueError(
+                    "speculator needs verify_call (the k-token paged "
+                    "chunk decode program)"
+                )
         self._slots: List[Optional[_Slot]] = [None] * self.num_slots
         self.results: Dict[Any, Result] = {}
         # Streaming feed: called with (request_id, token) the moment a
@@ -265,6 +293,10 @@ class Engine:
             out["free_pages"] = self.cache.free_pages
             out["page_size"] = self.cache.page_size
             out["kv_quantized"] = self.cache.quantized
+            if self.prefix_share:
+                out["prefix_cache"] = self.cache.radix.stats()
+            if self.speculator is not None:
+                out["spec_k"] = self.speculator.k
         else:
             out["write_index"] = self.cache.write_index
         return out
@@ -327,29 +359,70 @@ class Engine:
                 )
 
     def _seat(self, entry: _Entry, slot: int) -> None:
-        """Prefill one request (batch-1 program) and scatter it into
-        ``slot`` of the live cache; select its first token."""
+        """Prefill one request and scatter it into ``slot`` of the live
+        cache; select its first token. Radix mode first walks the
+        prefix tree: matched full pages seat for free, and the batch-1
+        program is replaced by the CHUNKED suffix prefill — prefill
+        cost drops from O(prompt window) to O(unshared suffix)."""
         req = entry.request
         ids = np.asarray(req.input_ids, np.int32)
-        pad = self.prompt_len - ids.shape[0]
-        padded = np.concatenate([np.zeros(pad, np.int32), ids])[None, :]
-        mask = np.concatenate(
-            [np.zeros(pad, np.int32), np.ones(ids.shape[0], np.int32)]
-        )[None, :]
         rec = active_recorder()
         t0 = self.clock()
-        logits, row_cache = self.prefill_call(self.params, padded, mask)
-        first = first_token(logits, req)
+        lease = None
+        hit = 0
+        row_offset = self.prompt_len - int(ids.shape[0])
+        try:
+            if self.prefix_share:
+                lease = self.cache.match_and_lease(ids)
+                # A fully-matched prompt still needs its LAST token's
+                # logits to select the first generated token, so the
+                # compute skip caps at ids_len - 1.
+                hit = min(len(lease[0]) * self.cache.page_size,
+                          int(ids.shape[0]) - 1)
+            if hit > 0 and self.chunk_prefill_call is not None:
+                rows = self.cache.gather_prefix_rows(lease[0], hit)
+                suffix = ids[hit:][None, :]
+                positions = np.arange(
+                    hit, ids.shape[0], dtype=np.int32
+                )[None, :]
+                logits, row_cache = self.chunk_prefill_call(
+                    self.params, rows, suffix, positions
+                )
+                row_offset = 0  # chunk rows are already left-aligned
+            else:
+                hit = 0  # no chunk program: full prefill, pages dedup only
+                pad = self.prompt_len - ids.shape[0]
+                padded = np.concatenate(
+                    [np.zeros(pad, np.int32), ids]
+                )[None, :]
+                mask = np.concatenate(
+                    [np.zeros(pad, np.int32),
+                     np.ones(ids.shape[0], np.int32)]
+                )[None, :]
+                logits, row_cache = self.prefill_call(
+                    self.params, padded, mask
+                )
+            first = first_token(logits, req)
+        except BaseException:
+            if lease is not None:
+                self.cache.release_lease(lease[1])
+            raise
         now = self.clock()
         if rec is not None:
             # request_id on the prefill span is the trace link between
-            # the queued event and this request's decode chunks.
+            # the queued event and this request's decode chunks;
+            # prefix_hit_tokens names how much of the prompt the radix
+            # cache paid for (report.py --request's TTFT attribution).
             rec.record("prefill", CAT_SERVE_PREFILL, t0, now - t0,
                        {"slot": slot, "request_id": req.request_id,
-                        "queue_wait_s": t0 - entry.submitted_at})
+                        "queue_wait_s": t0 - entry.submitted_at,
+                        "prefix_hit_tokens": hit})
+        if hit:
+            registry().counter("serve_prefix_hit_tokens").inc(hit)
         self.num_prefills += 1
         registry().counter("serve_prefills").inc()
-        self._install(entry, slot, row_cache, first, ids.shape[0], t0, now)
+        self._install(entry, slot, row_cache, first, ids.shape[0], t0, now,
+                      lease=lease, row_offset=row_offset)
 
     def _seat_prefilled(self, item: _Prefilled, slot: int) -> None:
         """Seat a request a DEDICATED prefill replica already prefilled
@@ -362,17 +435,38 @@ class Engine:
 
     def _install(self, entry: _Entry, slot: int, row_cache: Any,
                  first: int, ids_len: int, t_popped: float,
-                 t_first: float) -> None:
-        """Shared seat tail: cache insertion (dense scatter or paged
-        reservation+scatter), latency accounting, slot activation."""
+                 t_first: float, lease=None, row_offset: Optional[int] = None,
+                 ) -> None:
+        """Shared seat tail: cache insertion (dense scatter, paged
+        reservation+scatter, or radix-shared left-aligned seat),
+        latency accounting, draft-cache seating, slot activation."""
         req = entry.request
-        if self.paged:
+        if self.prefix_share:
+            ids = np.asarray(req.input_ids, np.int32)
+            if lease is None:
+                # Disaggregated handoff: the worker prefilled the full
+                # row; matched pages still dedup (values identical).
+                lease = self.cache.match_and_lease(ids)
+            self.cache.seat_shared(
+                row_cache, slot, ids, ids_len + req.max_new_tokens,
+                lease=lease,
+                row_offset=(
+                    self.prompt_len - ids_len
+                    if row_offset is None else row_offset
+                ),
+            )
+        elif self.paged:
             self.cache.seat(
                 row_cache, slot, self.prompt_len - ids_len,
                 self.prompt_len, self.prompt_len + req.max_new_tokens,
             )
         else:
             self.cache.insert(row_cache, slot)
+        if self.speculator is not None:
+            self.speculator.seat(
+                slot, np.asarray(req.input_ids, np.int32),
+                self.prompt_len, self.prompt_len + req.max_new_tokens,
+            )
         queue_wait_ms = 1e3 * (t_popped - entry.submitted_at)
         ttft_ms = 1e3 * (t_first - entry.submitted_at)
         reg = registry()
@@ -466,7 +560,28 @@ class Engine:
         """Can this request be seated RIGHT NOW? Dense: its worst case
         fits the remaining shared write horizon. Paged: its worst case
         fits the per-slot logical bound and enough pool pages are free
-        to reserve it up front (so it can never strand mid-decode)."""
+        to reserve it up front (so it can never strand mid-decode).
+        Radix mode counts only the UNSHARED pages (matched prefix
+        pages seat for free — sharing multiplies admission capacity on
+        top of int8's byte multiplier), and left-aligned seating
+        reserves from the real prompt length, not the padded window.
+        A speculating engine additionally needs draft-cache room."""
+        if self.speculator is not None:
+            # Pad-aligned draft seating reserves the full prompt
+            # window. submit() already validates prompt_len + max_new
+            # against the session bound, so the bound check here is
+            # belt-and-suspenders for work pushed straight onto the
+            # queue.
+            draft_need = self.prompt_len + request.max_new_tokens
+            if draft_need > self.speculator.cache.max_seq_len or not (
+                self.speculator.cache.fits_tokens(draft_need)
+            ):
+                return False
+        if self.prefix_share:
+            need = len(request.input_ids) + request.max_new_tokens
+            return need <= self.max_seq_len and self.cache.fits_request(
+                request.input_ids, need
+            )
         if self.paged:
             need = self.prompt_len + request.max_new_tokens
             return need <= self.max_seq_len and self.cache.fits_tokens(need)
@@ -477,11 +592,24 @@ class Engine:
         """Could this request be seated in an EMPTY cache? False means
         waiting can never help (the worst case exceeds the compiled
         seq-len bound, or the paged pool is too small outright)."""
-        need = self.prompt_len + request.max_new_tokens
+        need = (
+            len(request.input_ids) + request.max_new_tokens
+            if self.prefix_share
+            else self.prompt_len + request.max_new_tokens
+        )
         if need > self.max_seq_len:
             return False
+        if self.speculator is not None:
+            draft_need = self.prompt_len + request.max_new_tokens
+            if draft_need > self.speculator.cache.max_seq_len or (
+                self.speculator.cache.pages_needed(draft_need)
+                > self.speculator.cache.num_pages - 1
+            ):
+                return False
         if self.paged:
-            # Page 0 is the trash page; an empty pool frees the rest.
+            # Page 0 is the trash page; an empty pool frees the rest
+            # (radix mode: refcount-0 cached pages evict on demand, so
+            # the whole pool minus the trash page is reachable).
             return self.cache.pages_needed(need) <= self.cache.num_pages - 1
         return True
 
@@ -531,6 +659,8 @@ class Engine:
                 generation_s=s.t_last - s.t_first, num_tokens=n,
             )
         self.cache.free(slot)
+        if self.speculator is not None:
+            self.speculator.free(slot)
         self._slots[slot] = None
 
     def _decode_step(self) -> None:
@@ -601,16 +731,144 @@ class Engine:
                 self.on_token(s.request.request_id, tok)
             self._maybe_finish(i, tok)
 
+    def _spec_step(self) -> None:
+        """One speculative window: k draft dispatches propose, ONE
+        slot-batched target chunk dispatch verifies, acceptance emits
+        1..k tokens per slot. Rollback of a rejected tail is per-slot
+        ``lens`` bookkeeping on both caches (tpudl.serve.speculate's
+        lockstep contract: both saw the same window, both advance by
+        the emitted count)."""
+        from tpudl.serve.speculate import (
+            greedy_accept,
+            sample_accept,
+            softmax,
+        )
+
+        spec = self.speculator
+        k = spec.k
+        b = self.num_slots
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        tokens0 = np.zeros(b, np.int32)
+        positions0 = np.zeros(b, np.int32)
+        temps = np.zeros(b, np.float32)
+        seeds = np.zeros(b, np.uint32)
+        token_index = np.zeros(b, np.int32)
+        for i in active:
+            s = self._slots[i]
+            tokens0[i] = s.tokens[-1]
+            positions0[i] = s.position
+            temps[i] = s.request.temperature
+            seeds[i] = s.request.seed
+            token_index[i] = s.steps
+        rids = [self._slots[i].request.request_id for i in active]
+        rec = active_recorder()
+        t0 = self.clock()
+        proposals, q_probs = spec.propose(
+            tokens0, positions0, active, temps, seeds, token_index
+        )
+        # Verify window [t_last, p_1 .. p_{k-1}]: k input rows write k
+        # KV positions and yield the target's verdict on p_1 .. p_k.
+        chunk = np.concatenate([tokens0[:, None], proposals[:, : k - 1]],
+                               axis=1)
+        pos_chunk = positions0[:, None] + np.arange(k, dtype=np.int32)[None, :]
+        lens_before = {i: int(self.cache.lens[i]) for i in active}
+        logits, self.cache.cache = self.verify_call(
+            self.params, self.cache.cache, chunk, pos_chunk,
+            *self.cache.dispatch_args(),
+        )
+        sampling = any(temps[i] > 0 for i in active)
+        if sampling:
+            host_logits = np.asarray(logits, np.float32)
+            target_choice = host_logits.argmax(axis=-1).astype(np.int32)
+        else:
+            target_choice = np.asarray(_select_greedy(logits))
+        now = self.clock()
+        total_emitted = 0
+        total_accepted = 0
+        slot_accepted: List[int] = []  # aligned with rids (= active order)
+        slot_emitted: List[int] = []
+        for i in active:
+            s = self._slots[i]
+            req = s.request
+            if temps[i] > 0:
+                p_list = [
+                    softmax(host_logits[i, j], float(temps[i]))
+                    for j in range(k)
+                ]
+                emitted, accepted = sample_accept(
+                    proposals[i], q_probs[i], p_list,
+                    int(seeds[i]), int(token_index[i]),
+                )
+            else:
+                emitted, accepted = greedy_accept(
+                    proposals[i], target_choice[i]
+                )
+            emitted = emitted[: req.max_new_tokens - len(s.tokens)]
+            if req.eos_id is not None:
+                for idx, tok in enumerate(emitted):
+                    if tok == req.eos_id:
+                        emitted = emitted[: idx + 1]
+                        break
+            n = len(emitted)
+            # Rollback + advance in one move: lens lands exactly past
+            # the accepted rows; the rejected tail's page writes are
+            # masked garbage the next window overwrites.
+            self.cache.set_len(i, lens_before[i] + n)
+            spec.sync_len(i, n)
+            s.position += n
+            s.steps += n
+            s.t_last = now
+            total_emitted += n
+            total_accepted += min(accepted, n)
+            slot_accepted.append(min(accepted, n))
+            slot_emitted.append(n)
+            for tok in emitted:
+                s.tokens.append(int(tok))
+                if self.on_token is not None:
+                    self.on_token(req.request_id, int(tok))
+                self._maybe_finish(i, int(tok))
+                if self._slots[i] is None:
+                    break
+        if rec is not None:
+            # accepted/proposed on every speculative decode chunk: the
+            # per-step attribution report.py --request renders (where
+            # did TPOT go — draft quality is readable off the ratio).
+            # slot_accepted/slot_emitted align with rids so a single
+            # request's trace sums ITS OWN numbers, not the batch's.
+            rec.record("decode_step", CAT_SERVE_DECODE, t0, now - t0,
+                       {"busy": len(active), "rids": rids,
+                        "proposed": k * len(active),
+                        "proposed_per_slot": k,
+                        "accepted": total_accepted,
+                        "emitted": total_emitted,
+                        "slot_accepted": slot_accepted,
+                        "slot_emitted": slot_emitted})
+        self.num_decode_steps += 1
+        reg = registry()
+        reg.counter("serve_decode_steps").inc()
+        reg.counter("spec_proposed_tokens").inc(k * len(active))
+        reg.counter("spec_accepted_tokens").inc(total_accepted)
+        reg.counter("spec_emitted_tokens").inc(total_emitted)
+        # One slot-step per active slot per window: accepted/slot_steps
+        # is the per-STREAM acceptance rate (the bench's
+        # accepted-tokens/step), which a batch-summed ratio would
+        # overstate by the occupancy factor.
+        reg.counter("spec_slot_steps").inc(len(active))
+
     def step(self) -> bool:
-        """Seat what fits, run one decode step. False when fully
-        drained (no active slots and nothing seatable queued)."""
+        """Seat what fits, run one decode step (speculative window when
+        a speculator is attached). False when fully drained (no active
+        slots and nothing seatable queued)."""
         self._fill_slots()
         if not self._active():
             # Nothing seated: the queue is empty or held only expired
             # entries (shed during the fill's pop).
             self._record_shed(self.queue.drain_expired(), "shed_timeout")
             return False
-        self._decode_step()
+        if self.speculator is not None:
+            self._spec_step()
+        else:
+            self._decode_step()
         return True
 
     def run_until_drained(self) -> Dict[Any, Result]:

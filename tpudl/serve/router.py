@@ -180,6 +180,19 @@ class Replica:
         )
         return (h.get("slots_busy", 0) + h.get("queue_depth", 0)) / cap
 
+    def prefix_match_len(self, input_ids) -> int:
+        """Longest prompt prefix (tokens) this replica's radix tree
+        already holds — 0 when prefix sharing is off. Read-only and
+        lock-guarded inside the tree, so the router probes it from its
+        own thread while the replica thread serves."""
+        try:
+            cache = self.session.engine.cache
+            return int(getattr(cache, "prefix_match_len")(input_ids)) if (
+                getattr(cache, "prefix_share", False)
+            ) else 0
+        except Exception:
+            return 0
+
     # -- the replica thread --------------------------------------------
 
     def start(self) -> "Replica":
@@ -866,8 +879,13 @@ class Router:
         return rid
 
     def _pick(self, request: Request) -> Optional[Replica]:
-        """Sticky pin first (if its replica is still ready), else the
-        least-loaded ready replica. Callers hold ``_books``."""
+        """Sticky pin first (if its replica is still ready), then
+        PREFIX AFFINITY — the ready replica whose radix tree holds the
+        longest cached prefix of this prompt (at least one full page)
+        serves it with O(unshared suffix) prefill, which beats a
+        less-loaded cold replica re-paying the whole window — then
+        least-loaded. Affinity ties break by load, so identical-prefix
+        floods still spread. Callers hold ``_books``."""
         if request.session_key is not None:
             pinned = self._sticky.get(request.session_key)
             if (
@@ -877,6 +895,18 @@ class Router:
             ):
                 return next(
                     r for r in self.replicas if r.name == pinned
+                )
+        ready = self._ready_replicas()
+        if len(ready) > 1:
+            matches = [
+                (r.prefix_match_len(request.input_ids), r) for r in ready
+            ]
+            best = max(m for m, _ in matches)
+            if best > 0:
+                contenders = [r for m, r in matches if m == best]
+                return min(
+                    contenders,
+                    key=lambda r: (self._inflight[r.name], r.load),
                 )
         return self._least_loaded()
 
