@@ -737,6 +737,14 @@ def main(argv=None):
         "serve_vs_static_batching": serve.get(
             "serve_vs_static_batching"
         ),
+        # Dispatch hygiene (tpudl.analysis wired into serve_load's
+        # timed window): backend compiles observed during the decode
+        # steady state. Expected 0; bench_regress gates this
+        # zero-tolerance (any positive draw is a regression — a
+        # shape/dtype/static arg quietly varying per step).
+        "serve_steady_state_recompiles": serve.get(
+            "serve_steady_state_recompiles"
+        ),
         # Multi-replica router tier (tpudl.serve.router): routed
         # 2-replica throughput, scaling efficiency vs 2x one
         # replica, and the int8 paged KV cache's resident slots

@@ -74,6 +74,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from tpudl.analysis.dispatch import RecompileWatcher, assert_no_host_transfers
+
 # Workload shape: ragged max_new_tokens is WHY continuous batching wins
 # (a static batch waits for its longest row); the 4:1 long:short mix
 # mirrors the bimodal request lengths real serving sees.
@@ -277,13 +279,25 @@ def run_closed_loop(
     session, requests: Sequence, clock=time.perf_counter,
     warmup: bool = True,
 ) -> dict:
-    """Submit everything, drain, report throughput + tail latency."""
+    """Submit everything, drain, report throughput + tail latency.
+
+    The timed window doubles as a dispatch-hygiene audit
+    (tpudl.analysis): after warmup has compiled every program the
+    engine uses, the steady state must not recompile (the count is
+    banked as ``serve_steady_state_recompiles``, expected 0) and must
+    not implicitly transfer except the small per-step host control
+    arrays (h2d by design; every intended readback in the engine is an
+    explicit jax.device_get)."""
     if warmup:
         warmup_session(session)
     steps0 = session.engine.num_decode_steps
     rolls0 = session.engine.num_rollovers
     t0 = clock()
-    results = session.serve(list(requests))
+    with RecompileWatcher(label="serve steady state") as recompiles:
+        with assert_no_host_transfers(
+            allow=("h2d",), label="serve steady state"
+        ):
+            results = session.serve(list(requests))
     elapsed = clock() - t0
     stats = _latency_stats(results)
     stats.update(
@@ -292,6 +306,7 @@ def run_closed_loop(
         tokens_per_sec=round(stats["tokens"] / elapsed, 2),
         decode_steps=session.engine.num_decode_steps - steps0,
         rollovers=session.engine.num_rollovers - rolls0,
+        steady_state_recompiles=recompiles.count,
     )
     return stats
 
@@ -1265,6 +1280,11 @@ def measure_serve(n_requests: int = 16, num_slots: int = 4) -> dict:
         "serve_p99_tpot_ms": cmp["continuous"]["tpot"]["p99_ms"],
         "serve_vs_static_batching": cmp["speedup_tokens_per_sec"],
         "serve_vs_static_steps": cmp["speedup_steps"],
+        # Expected 0 — a recompile in the decode steady state is a
+        # dispatch regression; bench_regress gates it zero-tolerance.
+        "serve_steady_state_recompiles": cmp["continuous"][
+            "steady_state_recompiles"
+        ],
     }
 
 

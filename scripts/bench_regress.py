@@ -100,6 +100,11 @@ NOISE_BAND_FLOORS = {
     "serve_ttft_shared_prefix_ms": 0.50,
     "spec_accepted_tokens_per_step": 0.15,
     "serve_tokens_per_sec_spec": 0.30,
+    # Dispatch-hygiene count (tpudl.analysis wired into serve_load's
+    # steady state, banked from r07): expected EXACTLY 0 — it is a
+    # count of silent regressions, not a timing draw, so it gates
+    # zero-tolerance (see ZERO_TOLERANCE below).
+    "serve_steady_state_recompiles": 0.01,
 }
 DEFAULT_BAND_FLOOR = 0.08
 
@@ -115,6 +120,15 @@ LOWER_IS_BETTER = {
     "autoscale_recovery_s",
     "fleet_scrape_overhead_ms",
     "serve_ttft_shared_prefix_ms",
+    "serve_steady_state_recompiles",
+}
+
+#: Lower-is-better metrics whose banked baseline is 0 and must STAY 0:
+#: the ratio protocol divides by the median and goes silent on a zero
+#: baseline, so these gate on the absolute value instead — any
+#: positive draw is a regression regardless of bands.
+ZERO_TOLERANCE = {
+    "serve_steady_state_recompiles",
 }
 
 #: Non-measurement keys in a bench line: identifiers, config echoes,
@@ -193,7 +207,10 @@ def evaluate_regressions(
         ratio = value / baseline if baseline else None
         lower_better = metric in LOWER_IS_BETTER
         status = "ok"
-        if ratio is not None:
+        if metric in ZERO_TOLERANCE and baseline == 0:
+            # value/0 has no ratio: gate the count absolutely.
+            status = "regression" if value > 0 else "ok"
+        elif ratio is not None:
             if lower_better:
                 if ratio > 1.0 + band:
                     status = "regression"

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""tpudl static-analysis gate: concurrency + registry/metric linters
+with a ratcheted baseline.
+
+    python scripts/lint_tpudl.py              # gate the tree
+    python scripts/lint_tpudl.py --json       # machine-readable findings
+    python scripts/lint_tpudl.py --write-baseline   # re-baseline (ratchet!)
+    python scripts/lint_tpudl.py --knob-table # print the env-knob table
+
+Exit status: 0 when every finding is baselined (baselined + stale
+entries still warn on stderr), 1 when NEW findings exist, 2 on
+internal errors.
+
+The ratchet: ``analysis_baseline.json`` (repo root) lists known-debt
+fingerprints, each with a one-line justification. New findings fail
+the gate — fix them or baseline them IN THE SAME PR, with a reason.
+``--write-baseline`` preserves existing justifications and stamps new
+entries ``TODO: justify``; a TODO in the checked-in baseline should
+not survive review. Stale entries (debt that got paid) warn until
+deleted.
+
+Runs CPU-only and jax-free (pure AST), so it is cheap enough for
+tier-1 (tests/test_analysis.py runs the same evaluation in-process)
+and for scripts/ci_check.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "analysis_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="tpudl static analysis: concurrency + registry "
+        "linters with a ratcheted baseline"
+    )
+    ap.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="baseline JSON path (default: analysis_baseline.json)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit findings as JSON on stdout",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings "
+        "(existing justifications preserved)",
+    )
+    ap.add_argument(
+        "--knob-table", action="store_true",
+        help="print the generated TPUDL_* env-knob markdown table "
+        "and exit",
+    )
+    args = ap.parse_args(argv)
+
+    from tpudl.analysis import findings as F
+    from tpudl.analysis.lint import run_lint
+    from tpudl.analysis.registry import knob_table_markdown
+
+    if args.knob_table:
+        print(knob_table_markdown(), end="")
+        return 0
+
+    found = run_lint(REPO_ROOT)
+
+    if args.write_baseline:
+        existing = (
+            F.load_baseline(args.baseline)
+            if os.path.exists(args.baseline) else {}
+        )
+        entries = []
+        for finding in found:
+            prior = existing.get(finding.fingerprint)
+            entries.append(
+                F.BaselineEntry.from_finding(
+                    finding,
+                    prior.justification if prior else "TODO: justify",
+                )
+            )
+        F.save_baseline(args.baseline, entries)
+        print(
+            f"baselined {len(entries)} finding(s) -> {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    baseline = (
+        F.load_baseline(args.baseline)
+        if os.path.exists(args.baseline) else {}
+    )
+    result = F.apply_baseline(found, baseline)
+
+    if args.json:
+        print(json.dumps(
+            {
+                "new": [f.to_dict() for f in result.new],
+                "baselined": [f.to_dict() for f in result.baselined],
+                "stale": [e.fingerprint for e in result.stale],
+            },
+            indent=2,
+        ))
+    else:
+        for finding in result.new:
+            print(f"NEW  {finding.format()}")
+        for finding in result.baselined:
+            print(f"warn {finding.format()} (baselined)", file=sys.stderr)
+    for entry in result.stale:
+        print(
+            f"warn stale baseline entry {entry.fingerprint} "
+            f"({entry.rule} {entry.path} {entry.symbol}) — the debt "
+            f"was paid, delete it",
+            file=sys.stderr,
+        )
+    if result.new:
+        print(
+            f"\n{len(result.new)} new finding(s) — fix them or add "
+            f"justified baseline entries (see --write-baseline)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"lint_tpudl: clean ({len(result.baselined)} baselined, "
+        f"{len(result.stale)} stale)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

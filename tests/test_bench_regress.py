@@ -141,3 +141,17 @@ def test_aliases_map_to_canonical_names():
     # Every alias target is itself stable (no chains).
     for target in ALIASES.values():
         assert target not in ALIASES
+
+
+def test_zero_tolerance_metric_gates_on_absolute_value():
+    """serve_steady_state_recompiles banks at 0, where the ratio
+    protocol is blind (value/0 has no ratio): any positive draw must
+    classify as regression, and staying at 0 as ok."""
+    hist = [
+        {"serve_steady_state_recompiles": 0.0},
+        {"serve_steady_state_recompiles": 0.0},
+    ]
+    bad = evaluate_regressions({"serve_steady_state_recompiles": 3.0}, hist)
+    assert bad[0]["status"] == "regression"
+    good = evaluate_regressions({"serve_steady_state_recompiles": 0.0}, hist)
+    assert good[0]["status"] == "ok"

@@ -487,3 +487,23 @@ def test_replica_inbox_shed_trace_is_not_partial(tmp_path):
     tl2 = obs_report.build_request_timeline(records[:2], "late")
     assert len(tl2["warnings"]) == 1
     assert "no completion event" in tl2["warnings"][0]
+
+
+def test_fleet_monitor_lock_order_wrapped_and_clean(monkeypatch):
+    """TPUDL_DEBUG_LOCK_ORDER wraps the FleetMonitor's lock in the
+    ordered-lock monitor; scrape + rollup under it record no
+    violations (the fleet half of the router/fleet runtime lock-order
+    coverage)."""
+    from tpudl.analysis import concurrency as conc
+
+    monitor = conc.LockOrderMonitor()
+    monkeypatch.setattr(conc, "_default_monitor", monitor)
+    monkeypatch.setenv("TPUDL_DEBUG_LOCK_ORDER", "1")
+    with obs_exporter.ObsExporter(port=0) as ex:
+        obs_counters.registry().counter("serve_decode_steps").inc(3)
+        fleet = FleetMonitor({"self": ex.snapshot}, scrape_interval_s=0.0)
+        assert isinstance(fleet._lock, conc.OrderedLock)
+        fleet.scrape()
+        roll = fleet.fleet_snapshot()
+    assert roll["sources"]["self"]["ok"]
+    assert monitor.violations == []

@@ -542,7 +542,12 @@ def test_donation_audit_single_and_fused():
     """Train-mode compile_step AND the fused K-step program donate the
     state buffers: every old state leaf is deleted after the call, and
     the output state reuses the donated buffers (pointer identity on
-    CPU) rather than silently copying. Eval steps must NOT donate."""
+    CPU) rather than silently copying. Eval steps must NOT donate.
+
+    Runs through the generalized tpudl.analysis.donation audit (this
+    test's original inline check, promoted to a reusable helper)."""
+    from tpudl.analysis.donation import audit_donation
+
     mesh = make_mesh(MeshSpec(dp=-1))
     state = _bert_state()
     step = compile_step(
@@ -552,34 +557,20 @@ def test_donation_audit_single_and_fused():
     batch = _token_batches(1)[0]
     rng = jax.random.key(1)
 
-    def ptrs(tree):
-        out = set()
-        for leaf in jax.tree.leaves(tree):
-            for shard in leaf.addressable_shards:
-                out.add(shard.data.unsafe_buffer_pointer())
-        return out
-
-    old_leaves = jax.tree.leaves(state)
-    old_ptrs = ptrs(state)
-    state2, _ = step(state, batch, rng)
-    assert all(leaf.is_deleted() for leaf in old_leaves)
-    reused = ptrs(state2) & old_ptrs
-    # Most buffers must be reused in place, not copied: allow a few
-    # small leaves (step counter, scalars) to land elsewhere.
-    assert len(reused) >= 0.8 * len(old_ptrs), (
-        f"only {len(reused)}/{len(old_ptrs)} donated buffers reused — "
-        "a leaf is silently copying"
+    # Most buffers must be reused in place, not copied: min_reuse=0.8
+    # allows a few small leaves (step counter, scalars) elsewhere.
+    (state2, _), report = audit_donation(
+        step, (state, batch, rng), donate_argnums=(0,)
     )
+    assert report.ok, report.describe()
 
     window = {k: np.stack([batch[k]] * 4) for k in batch}
-    old_leaves2 = jax.tree.leaves(state2)
-    old_ptrs2 = ptrs(state2)
-    state3, stacked = step.window_step(state2, window, rng)
-    assert all(leaf.is_deleted() for leaf in old_leaves2)
-    reused2 = ptrs(state3) & old_ptrs2
-    assert len(reused2) >= 0.8 * len(old_ptrs2), (
-        f"fused program: only {len(reused2)}/{len(old_ptrs2)} donated "
-        "buffers reused across the scan carry"
+    (state3, stacked), report2 = audit_donation(
+        step.window_step, (state2, window, rng), donate_argnums=(0,)
+    )
+    assert report2.ok, (
+        f"fused program: {report2.describe()} (donation lost across "
+        f"the scan carry)"
     )
     assert np.asarray(stacked["loss"]).shape == (4,)
 

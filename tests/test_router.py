@@ -490,3 +490,50 @@ def test_prefill_worker_failure_surfaces_not_kills(model_and_params):
     assert results["bad"].tokens == []
     assert worker.num_prefills == 3, "worker thread died on the poison"
     _assert_generate_parity(model, params, good, results)
+
+
+def test_router_lock_order_monitor_clean_under_traffic(
+    model_and_params, monkeypatch
+):
+    """TPUDL_DEBUG_LOCK_ORDER: real traffic over wrapped router +
+    replica locks builds the live cross-object held-before graph with
+    ZERO inversions, checked against the ranks the STATIC pass derives
+    from the serve/obs sources (tpudl.analysis.concurrency) — the
+    runtime half of the ISSUE-12 concurrency tier, on the exact
+    subsystem whose _deadline_at/_books races motivated it."""
+    import os
+
+    import tpudl
+    from tpudl.analysis import concurrency as conc
+
+    tpudl_dir = os.path.dirname(tpudl.__file__)
+    ranks = conc.derive_lock_ranks(
+        [os.path.join(tpudl_dir, "serve"), os.path.join(tpudl_dir, "obs")]
+    )
+    monitor = conc.LockOrderMonitor(ranks=ranks)
+    monkeypatch.setattr(conc, "_default_monitor", monitor)
+    monkeypatch.setenv("TPUDL_DEBUG_LOCK_ORDER", "1")
+
+    model, params = model_and_params
+    replicas = [
+        Replica(f"lo{i}", _session(model, params)) for i in range(2)
+    ]
+    # The flag was live at construction: the books and both replicas'
+    # result locks must be wrapped.
+    requests = _greedy_requests(4, seed=11)
+    with Router(replicas) as router:
+        assert isinstance(router._books, conc.OrderedLock)
+        assert all(
+            isinstance(r._results_lock, conc.OrderedLock)
+            for r in replicas
+        )
+        results = router.serve(requests, timeout_s=300.0)
+    assert set(results) == {r.request_id for r in requests}
+    _assert_generate_parity(model, params, requests, results)
+    assert monitor.violations == [], monitor.violations
+    # The wrapper was live: the monitor saw the router's book
+    # acquisitions. (The edge set is empty BY DESIGN — the router
+    # never holds two locks at once, e.g. _harvest_one drains
+    # replica.take() before entering the books; the monitor existing
+    # is what keeps that property from silently regressing.)
+    assert monitor.acquisitions > 0

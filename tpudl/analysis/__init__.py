@@ -1,0 +1,93 @@
+"""tpudl.analysis — first-party static + runtime analysis tier.
+
+Three families (ISSUE 12), one ratcheted gate:
+
+- ``concurrency``: per-class lock-acquisition graphs (lock-order
+  inversions), guarded-vs-unguarded shared-attribute writes, and the
+  ``TPUDL_DEBUG_LOCK_ORDER`` runtime ordered-lock monitor.
+- ``dispatch``: runtime audits for the compiled hot paths —
+  ``assert_no_recompiles`` / ``assert_no_host_transfers`` (jax
+  monitoring + transfer guards) and the generalized buffer-donation
+  audit (``donation``).
+- ``registry`` + ``lint``: the central ``TPUDL_*`` knob declaration
+  table, typed env accessors, the Prometheus metric-name conformance
+  rule, and the AST linter enforcing all of it.
+
+``scripts/lint_tpudl.py`` runs the static families against the
+checked-in ``analysis_baseline.json`` (new findings fail, baselined
+ones warn) and is part of tier-1 via tests/test_analysis.py.
+
+This package keeps its import cost near zero: ``registry`` is
+stdlib-only (it is imported by tpudl.obs.counters and the runtime
+bootstrap), and the analyzer modules — some of which import jax —
+load lazily on first attribute access.
+"""
+
+from __future__ import annotations
+
+from tpudl.analysis.findings import (  # noqa: F401
+    BaselineEntry,
+    Finding,
+    GateResult,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from tpudl.analysis.registry import (  # noqa: F401
+    KNOBS,
+    METRIC_NAME_RE,
+    env_flag,
+    env_float,
+    env_int,
+    env_raw,
+    env_require,
+    env_str,
+    knob_table_markdown,
+)
+
+_LAZY = {
+    "analyze_paths": ("tpudl.analysis.concurrency", "analyze_paths"),
+    "derive_lock_ranks": (
+        "tpudl.analysis.concurrency", "derive_lock_ranks"
+    ),
+    "LockOrderMonitor": (
+        "tpudl.analysis.concurrency", "LockOrderMonitor"
+    ),
+    "LockOrderViolation": (
+        "tpudl.analysis.concurrency", "LockOrderViolation"
+    ),
+    "wrap_instance_locks": (
+        "tpudl.analysis.concurrency", "wrap_instance_locks"
+    ),
+    "maybe_wrap_locks": (
+        "tpudl.analysis.concurrency", "maybe_wrap_locks"
+    ),
+    "assert_no_recompiles": (
+        "tpudl.analysis.dispatch", "assert_no_recompiles"
+    ),
+    "assert_no_host_transfers": (
+        "tpudl.analysis.dispatch", "assert_no_host_transfers"
+    ),
+    "RecompileWatcher": ("tpudl.analysis.dispatch", "RecompileWatcher"),
+    "DispatchHygieneError": (
+        "tpudl.analysis.dispatch", "DispatchHygieneError"
+    ),
+    "audit_donation": ("tpudl.analysis.donation", "audit_donation"),
+    "assert_donation": ("tpudl.analysis.donation", "assert_donation"),
+    "DonationError": ("tpudl.analysis.donation", "DonationError"),
+    "run_lint": ("tpudl.analysis.lint", "run_lint"),
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module 'tpudl.analysis' has no attribute {name!r}"
+        )
+    import importlib
+
+    module = importlib.import_module(target[0])
+    value = getattr(module, target[1])
+    globals()[name] = value
+    return value

@@ -1,0 +1,853 @@
+"""Concurrency static analysis + runtime lock-order monitor.
+
+Every review round of the threaded serving stack (PR 8-11) hand-found
+the same two defect classes; this module turns those audits into
+machinery:
+
+**Static pass** (``analyze_paths``): an AST walk over each class that
+owns ``threading.Lock`` / ``RLock`` / ``Condition`` attributes,
+building
+
+- the per-class **lock-acquisition graph**: which lock is held when a
+  ``with self.<other_lock>`` region (or a method that transitively
+  acquires one) is entered. A cycle in that graph is a lock-order
+  inversion — two threads entering it from different ends deadlock —
+  reported as rule ``lock-order-inversion`` (P0).
+- the **write-discipline map**: every ``self.<attr>`` store site and
+  the locks held there. An attribute written at least once INSIDE a
+  lock region and at least once outside any (construction in
+  ``__init__`` excluded — no other thread can hold a reference yet) is
+  rule ``unguarded-shared-write`` (P0): either the lock is load-bearing
+  and the unguarded site races it, or it isn't and the guarded site is
+  lying to the reader.
+
+``threading.Condition(self._lock)`` aliases the condition attribute to
+the underlying lock's group, so ``with self._not_empty:`` counts as
+holding ``_lock``. Private methods (leading underscore) inherit the
+intersection of locks held at their intra-class call sites — the
+"callers hold ``_books``" idiom analyzes correctly without
+annotations.
+
+**Runtime companion** (``TPUDL_DEBUG_LOCK_ORDER``): ``OrderedLock``
+wraps a real lock and reports every acquisition to a process-global
+``LockOrderMonitor`` that maintains the live held-before graph ACROSS
+objects (the static pass is per-class; the classic router-holds-books-
+calls-replica / replica-holds-results-calls-router deadlock spans
+two). A new edge that closes a cycle — or an acquisition that violates
+the statically derived rank order (``derive_lock_ranks``) — raises
+``LockOrderViolation`` at the acquire site, naming both lock chains.
+``Router``/``Replica``/``FleetMonitor`` opt in via
+``maybe_wrap_locks`` when the flag is set (the router/fleet tests
+drive real traffic under it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tpudl.analysis.findings import Finding
+from tpudl.analysis.registry import env_flag
+
+LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+#: Method calls treated as WRITES to the receiving attribute (mutating
+#: a shared container is a shared write even without an ``=``).
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "add", "discard", "update", "setdefault",
+    "sort", "reverse",
+}
+
+
+# ---------------------------------------------------------------------------
+# static pass
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _WriteSite:
+    attr: str
+    line: int
+    held: frozenset
+    method: str
+
+
+@dataclasses.dataclass
+class _MethodInfo:
+    name: str
+    #: lock groups acquired directly via ``with`` anywhere in the body
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    #: (held-at-site, acquired-group, line)
+    acquire_sites: List[Tuple[frozenset, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+    writes: List[_WriteSite] = dataclasses.field(default_factory=list)
+    #: (held-at-site, callee, line)
+    calls: List[Tuple[frozenset, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+    #: locks guaranteed held on entry (callers' intersection)
+    inherited: frozenset = frozenset()
+
+
+class _LockCollector(ast.NodeVisitor):
+    """Pass 1: find the class's lock attributes and their alias groups
+    (a Condition built over a lock belongs to that lock's group)."""
+
+    def __init__(self):
+        self.groups: Dict[str, str] = {}  # attr -> canonical group name
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        factory = _lock_factory_name(value)
+        if factory is not None:
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is None and isinstance(target, ast.Name):
+                    attr = target.id  # module-level lock
+                if attr is None:
+                    continue
+                group = attr
+                if factory == "Condition" and value.args:
+                    inner = _self_attr(value.args[0])
+                    if inner is None and isinstance(
+                        value.args[0], ast.Name
+                    ):
+                        inner = value.args[0].id
+                    if inner is not None:
+                        group = self.groups.get(inner, inner)
+                self.groups[attr] = group
+        self.generic_visit(node)
+
+
+def _lock_factory_name(node: ast.AST) -> Optional[str]:
+    """'Lock' / 'RLock' / 'Condition' when node is a call to
+    threading.<factory>() (or a bare <factory>() import)."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in LOCK_FACTORIES:
+        if isinstance(func.value, ast.Name) and func.value.id == "threading":
+            return func.attr
+    if isinstance(func, ast.Name) and func.id in LOCK_FACTORIES:
+        return func.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Pass 2: walk one method body tracking the held-lock stack."""
+
+    def __init__(self, method: str, groups: Dict[str, str]):
+        self.groups = groups
+        self.info = _MethodInfo(name=method)
+        self._held: List[str] = []
+
+    # -- lock regions ---------------------------------------------------
+
+    def _lock_group_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is None and isinstance(expr, ast.Name):
+            attr = expr.id
+        if attr is None:
+            return None
+        return self.groups.get(attr)
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: List[str] = []
+        for item in node.items:
+            group = self._lock_group_of(item.context_expr)
+            if group is not None:
+                held = frozenset(self._held)
+                if group not in held:
+                    self.info.acquires.add(group)
+                    self.info.acquire_sites.append(
+                        (held, group, node.lineno)
+                    )
+                self._held.append(group)
+                entered.append(group)
+            else:
+                # Non-lock context managers still get visited for
+                # nested locks/writes.
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in entered:
+            self._held.pop()
+
+    # -- writes ---------------------------------------------------------
+
+    def _record_write(self, attr: Optional[str], line: int) -> None:
+        if attr is None or attr in self.groups:
+            return  # not a self attribute, or the lock itself
+        self.info.writes.append(
+            _WriteSite(
+                attr=attr,
+                line=line,
+                held=frozenset(self._held),
+                method=self.info.name,
+            )
+        )
+
+    def _write_target(self, target: ast.AST, line: int) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._write_target(elt, line)
+            return
+        attr = _self_attr(target)
+        if attr is not None:
+            self._record_write(attr, line)
+            return
+        if isinstance(target, ast.Subscript):
+            self._write_target_container(target.value, line)
+        if isinstance(target, ast.Starred):
+            self._write_target(target.value, line)
+
+    def _write_target_container(self, node: ast.AST, line: int) -> None:
+        """``self.x[k] = v`` writes x; ``self.x[k][j] = v`` too."""
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record_write(attr, line)
+        elif isinstance(node, ast.Subscript):
+            self._write_target_container(node.value, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._write_target(target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._write_target(node.target, node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._write_target(node.target, node.lineno)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                self._write_target_container(target.value, node.lineno)
+            else:
+                attr = _self_attr(target)
+                if attr is not None:
+                    self._record_write(attr, node.lineno)
+
+    # -- calls ----------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            # self.m(...) -> intra-class call edge
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+            ):
+                self.info.calls.append(
+                    (frozenset(self._held), func.attr, node.lineno)
+                )
+            # self.x.append(...) -> container mutation = write
+            recv = _self_attr(func.value)
+            if recv is not None and func.attr in MUTATOR_METHODS:
+                self._record_write(recv, node.lineno)
+        self.generic_visit(node)
+
+    # Nested defs get their own analysis scope only for writes/locks
+    # textually inside them — a closure runs on an unknown thread, so
+    # treat its body like part of the method (conservative: the held
+    # stack at the DEF site does not apply at call time).
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self._held = self._held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self._held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self._held = self._held, []
+        self.visit(node.body)
+        self._held = saved
+
+
+def _analyze_class(
+    node: ast.ClassDef, path: str, module_groups: Dict[str, str]
+) -> List[Finding]:
+    collector = _LockCollector()
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            collector.visit(item)
+    groups = dict(module_groups)
+    groups.update(collector.groups)
+    if not collector.groups:
+        return []  # lockless class: nothing to check
+    own_groups = set(collector.groups.values()) | set(
+        module_groups.values()
+    )
+
+    methods = _build_methods(node, groups)
+    findings: List[Finding] = []
+    findings.extend(
+        _order_findings(node.name, path, methods, own_groups)
+    )
+    findings.extend(
+        _write_findings(node.name, path, methods, own_groups)
+    )
+    return findings
+
+
+def _propagate_inherited(methods: Dict[str, _MethodInfo]) -> None:
+    """Private methods called only with a lock held analyze as if they
+    acquired it: inherited = intersection of (held + caller inherited)
+    across intra-class call sites. Public methods never inherit (any
+    external caller holds nothing)."""
+    for _ in range(4):  # small fixed point; call chains are shallow
+        changed = False
+        for name, info in methods.items():
+            if not name.startswith("_") or name.startswith("__"):
+                continue
+            site_holds = [
+                frozenset(held | caller_info.inherited)
+                for caller_info in methods.values()
+                for (held, callee, _line) in caller_info.calls
+                if callee == name
+            ]
+            if not site_holds:
+                continue
+            inherited = frozenset.intersection(*site_holds)
+            if inherited != info.inherited:
+                info.inherited = inherited
+                changed = True
+        if not changed:
+            break
+
+
+def _transitive_acquires(
+    methods: Dict[str, _MethodInfo],
+) -> Dict[str, Set[str]]:
+    closure = {n: set(m.acquires) for n, m in methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, info in methods.items():
+            for _held, callee, _line in info.calls:
+                extra = closure.get(callee, set()) - closure[name]
+                if extra:
+                    closure[name] |= extra
+                    changed = True
+    return closure
+
+
+def _build_methods(
+    node: ast.ClassDef, groups: Dict[str, str]
+) -> Dict[str, _MethodInfo]:
+    """Walk every method of a class and resolve inherited locks — the
+    shared front half of finding-generation AND rank derivation."""
+    methods: Dict[str, _MethodInfo] = {}
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        walker = _MethodWalker(item.name, groups)
+        for stmt in item.body:
+            walker.visit(stmt)
+        methods[item.name] = walker.info
+    _propagate_inherited(methods)
+    return methods
+
+
+def _collect_edges(
+    methods: Dict[str, _MethodInfo],
+) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """Held-before edges A -> B with one example (method, line) each:
+    direct ``with`` nesting plus acquisitions reached through the
+    intra-class call graph. The ONE edge definition — findings and the
+    runtime monitor's static ranks both consume it."""
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    closure = _transitive_acquires(methods)
+    for name, info in methods.items():
+        for held, group, line in info.acquire_sites:
+            for h in held | info.inherited:
+                if h != group:
+                    edges.setdefault((h, group), (name, line))
+        for held, callee, line in info.calls:
+            for acquired in closure.get(callee, set()):
+                for h in held | info.inherited:
+                    if h != acquired:
+                        edges.setdefault((h, acquired), (name, line))
+    return edges
+
+
+def _order_findings(
+    cls: str,
+    path: str,
+    methods: Dict[str, _MethodInfo],
+    own_groups: Set[str],
+) -> List[Finding]:
+    edges = _collect_edges(methods)
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+
+    findings: List[Finding] = []
+    reported: Set[frozenset] = set()
+    for a, b in sorted(edges):
+        if (b, a) not in edges:
+            continue
+        pair = frozenset((a, b))
+        if pair in reported:
+            continue
+        reported.add(pair)
+        m1, l1 = edges[(a, b)]
+        m2, l2 = edges[(b, a)]
+        findings.append(
+            Finding(
+                rule="lock-order-inversion",
+                path=path,
+                line=l1,
+                symbol=f"{cls}.{m1}",
+                message=(
+                    f"lock '{a}' is held while acquiring '{b}' "
+                    f"(in {m1}) AND '{b}' while acquiring '{a}' "
+                    f"(in {m2}:{l2}) — two threads entering from "
+                    f"different ends deadlock"
+                ),
+                severity="P0",
+            )
+        )
+    # Longer cycles (A->B->C->A) without any 2-cycle inside.
+    for cycle in _simple_cycles(graph):
+        if len(cycle) < 3:
+            continue
+        pair = frozenset(cycle)
+        if any(
+            frozenset((x, y)) in reported
+            for x in cycle for y in cycle if x != y
+        ):
+            continue
+        reported.add(pair)
+        a, b = cycle[0], cycle[1]
+        m1, l1 = edges[(a, b)]
+        findings.append(
+            Finding(
+                rule="lock-order-inversion",
+                path=path,
+                line=l1,
+                symbol=f"{cls}.{m1}",
+                message=(
+                    "lock-acquisition cycle "
+                    + " -> ".join(cycle + [cycle[0]])
+                ),
+                severity="P0",
+            )
+        )
+    return findings
+
+
+def _simple_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Small-graph cycle enumeration (lock graphs have <10 nodes)."""
+    cycles: List[List[str]] = []
+    seen: Set[frozenset] = set()
+
+    def dfs(start: str, node: str, trail: List[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(trail) > 1:
+                key = frozenset(trail)
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(list(trail))
+            elif nxt not in trail and nxt > start:
+                dfs(start, nxt, trail + [nxt])
+
+    for start in sorted(graph):
+        dfs(start, start, [start])
+    return cycles
+
+
+def _write_findings(
+    cls: str,
+    path: str,
+    methods: Dict[str, _MethodInfo],
+    own_groups: Set[str],
+) -> List[Finding]:
+    by_attr: Dict[str, List[_WriteSite]] = {}
+    for name, info in methods.items():
+        if name == "__init__":
+            continue  # construction: no other thread holds a reference
+        for site in info.writes:
+            effective = site.held | info.inherited
+            by_attr.setdefault(site.attr, []).append(
+                dataclasses.replace(site, held=frozenset(effective))
+            )
+    findings: List[Finding] = []
+    for attr in sorted(by_attr):
+        sites = by_attr[attr]
+        guarded = [s for s in sites if s.held & own_groups]
+        unguarded = [s for s in sites if not (s.held & own_groups)]
+        if not guarded or not unguarded:
+            continue
+        locks = sorted({g for s in guarded for g in s.held & own_groups})
+        seen_methods: Set[str] = set()
+        for site in unguarded:
+            if site.method in seen_methods:
+                continue
+            seen_methods.add(site.method)
+            findings.append(
+                Finding(
+                    rule="unguarded-shared-write",
+                    path=path,
+                    line=site.line,
+                    symbol=f"{cls}.{site.method}",
+                    message=(
+                        f"attribute '{attr}' is written under lock "
+                        f"{'/'.join(locks)} elsewhere in {cls} but "
+                        f"without a lock here"
+                    ),
+                    severity="P0",
+                )
+            )
+    return findings
+
+
+def analyze_source(source: str, path: str) -> List[Finding]:
+    """Run the concurrency pass over one file's source text."""
+    tree = ast.parse(source, filename=path)
+    module_collector = _LockCollector()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            module_collector.visit(node)
+    findings: List[Finding] = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            findings.extend(
+                _analyze_class(node, path, module_collector.groups)
+            )
+    return findings
+
+
+def analyze_file(path: str, repo_root: Optional[str] = None) -> List[Finding]:
+    with open(path) as f:
+        source = f.read()
+    rel = os.path.relpath(path, repo_root) if repo_root else path
+    return analyze_source(source, rel.replace(os.sep, "/"))
+
+
+def analyze_paths(
+    paths: Sequence[str], repo_root: Optional[str] = None
+) -> List[Finding]:
+    """Concurrency findings for every ``.py`` under ``paths`` (files or
+    directories)."""
+    findings: List[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [
+                    d for d in dirnames if d != "__pycache__"
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        findings.extend(
+                            analyze_file(
+                                os.path.join(dirpath, fn), repo_root
+                            )
+                        )
+        else:
+            findings.extend(analyze_file(path, repo_root))
+    return findings
+
+
+def derive_lock_ranks(
+    paths: Sequence[str], repo_root: Optional[str] = None
+) -> Dict[str, int]:
+    """Topological ranks for the runtime monitor, derived from the
+    per-class acquisition graphs: ``{"Class.attr": rank}`` where a lock
+    acquired while another is held ranks HIGHER (acquire low-to-high).
+    Locks on a static cycle (already a P0 finding) get no rank."""
+    edges: Set[Tuple[str, str]] = set()
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py")
+                )
+        else:
+            files.append(path)
+    for file in files:
+        with open(file) as f:
+            tree = ast.parse(f.read(), filename=file)
+        module_collector = _LockCollector()
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                module_collector.visit(node)
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            collector = _LockCollector()
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    collector.visit(item)
+            if not collector.groups:
+                continue
+            groups = dict(module_collector.groups)
+            groups.update(collector.groups)
+            methods = _build_methods(node, groups)
+            for (a, b) in _collect_edges(methods):
+                edges.add((f"{node.name}.{a}", f"{node.name}.{b}"))
+    # Kahn topo-sort; cycle members drop out unranked.
+    nodes = {n for e in edges for n in e}
+    indeg = {n: 0 for n in nodes}
+    for _a, b in edges:
+        indeg[b] += 1
+    ranks: Dict[str, int] = {}
+    frontier = sorted(n for n, d in indeg.items() if d == 0)
+    rank = 0
+    while frontier:
+        nxt: List[str] = []
+        for n in frontier:
+            ranks[n] = rank
+            for a, b in edges:
+                if a == n:
+                    indeg[b] -= 1
+                    if indeg[b] == 0:
+                        nxt.append(b)
+        frontier = sorted(set(nxt))
+        rank += 1
+    return ranks
+
+
+# ---------------------------------------------------------------------------
+# runtime companion: TPUDL_DEBUG_LOCK_ORDER
+# ---------------------------------------------------------------------------
+
+
+class LockOrderViolation(RuntimeError):
+    """An acquisition that closes a cycle in the live held-before graph
+    or violates the statically derived lock order."""
+
+
+class LockOrderMonitor:
+    """Process-global held-before graph over named locks.
+
+    Each ``OrderedLock`` reports acquisitions; the monitor records the
+    edge (held -> acquired) for every lock the acquiring thread already
+    holds, and raises :class:`LockOrderViolation` when a NEW edge closes
+    a cycle — i.e. some other code path acquires these locks in the
+    opposite order, which deadlocks under the right interleaving even
+    if this run got lucky. With ``ranks`` (see
+    :func:`derive_lock_ranks`) it additionally asserts the static
+    order: acquiring a lower-ranked lock while holding a higher-ranked
+    one is an inversion even before the reverse path ever runs."""
+
+    def __init__(
+        self,
+        ranks: Optional[Dict[str, int]] = None,
+        raise_on_violation: bool = True,
+    ):
+        self.ranks = dict(ranks or {})
+        self.raise_on_violation = raise_on_violation
+        self.violations: List[str] = []
+        #: Total acquisitions observed — proves wrapping is live even
+        #: when the code never nests two locks (edge set empty).
+        self.acquisitions = 0
+        self._edges: Dict[str, Set[str]] = {}
+        self._mu = threading.Lock()  # guards _edges/violations
+        self._tls = threading.local()
+
+    # -- per-thread held stack -----------------------------------------
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def held(self) -> Tuple[str, ...]:
+        return tuple(self._stack())
+
+    # -- the check ------------------------------------------------------
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen = {src}
+        frontier = [src]
+        while frontier:
+            node = frontier.pop()
+            for nxt in self._edges.get(node, ()):
+                if nxt == dst:
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        return False
+
+    def _violate(self, message: str) -> None:
+        self.violations.append(message)
+        if self.raise_on_violation:
+            raise LockOrderViolation(message)
+
+    def on_acquire(self, name: str, reentrant: bool = True) -> None:
+        stack = self._stack()
+        with self._mu:
+            self.acquisitions += 1
+        if name in stack:
+            if not reentrant:
+                # A plain Lock re-acquired by its holding thread blocks
+                # forever — the classic self-deadlock, and exactly the
+                # defect class this monitor exists for.
+                self._violate(
+                    f"self-deadlock: thread re-acquires non-reentrant "
+                    f"lock '{name}' it already holds "
+                    f"(held: {list(stack)})"
+                )
+            stack.append(name)  # RLock reentry: no ordering information
+            return
+        held = [h for h in stack if h != name]
+        with self._mu:
+            for h in set(held):
+                # Cycle check BEFORE inserting: does a path name->...->h
+                # already exist? Then h-before-name and name-before-h
+                # both happen — the deadlock interleaving exists.
+                if self._reaches(name, h):
+                    self._violate(
+                        f"lock-order inversion: acquiring '{name}' "
+                        f"while holding '{h}', but '{name}' is already "
+                        f"held before '{h}' on another path "
+                        f"(held here: {list(stack)})"
+                    )
+                self._edges.setdefault(h, set()).add(name)
+            rank = self.ranks.get(name)
+            if rank is not None:
+                for h in set(held):
+                    h_rank = self.ranks.get(h)
+                    if h_rank is not None and h_rank > rank:
+                        self._violate(
+                            f"static lock order violated: acquiring "
+                            f"'{name}' (rank {rank}) while holding "
+                            f"'{h}' (rank {h_rank}) — the derived "
+                            f"order acquires low-to-high"
+                        )
+        stack.append(name)
+
+    def on_release(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    def edges(self) -> Dict[str, Set[str]]:
+        with self._mu:
+            return {a: set(bs) for a, bs in self._edges.items()}
+
+
+_default_monitor: Optional[LockOrderMonitor] = None
+_default_monitor_mu = threading.Lock()
+
+
+def default_monitor() -> LockOrderMonitor:
+    global _default_monitor
+    if _default_monitor is None:
+        with _default_monitor_mu:
+            if _default_monitor is None:
+                _default_monitor = LockOrderMonitor()
+    return _default_monitor
+
+
+class OrderedLock:
+    """A Lock/RLock wrapper that reports to a :class:`LockOrderMonitor`.
+    Context-manager and acquire/release compatible; everything else
+    delegates to the wrapped lock."""
+
+    def __init__(self, inner, name: str, monitor: LockOrderMonitor):
+        self._inner = inner
+        self._name = name
+        self._monitor = monitor
+        self._reentrant = isinstance(inner, type(threading.RLock()))
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def acquire(self, *args, **kwargs):
+        # Check BEFORE blocking: the inversion is a property of the
+        # order, not of whether this particular run deadlocks.
+        self._monitor.on_acquire(self._name, reentrant=self._reentrant)
+        ok = False
+        try:
+            ok = self._inner.acquire(*args, **kwargs)
+            return ok
+        finally:
+            if not ok:
+                # A failed non-blocking/timed acquire (False return OR
+                # exception) never held the lock: pop the speculative
+                # stack entry or every later acquisition on this thread
+                # sees a phantom held lock.
+                self._monitor.on_release(self._name)
+
+    def release(self):
+        self._monitor.on_release(self._name)
+        return self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+
+def wrap_instance_locks(
+    obj,
+    monitor: Optional[LockOrderMonitor] = None,
+    prefix: Optional[str] = None,
+) -> List[str]:
+    """Replace every plain Lock/RLock attribute on ``obj`` with an
+    :class:`OrderedLock` named ``Class.attr`` (matching the static
+    pass's rank names). Conditions are left alone — a Condition holds a
+    reference to its underlying lock, and swapping one out from under
+    it would desynchronize them. Returns the wrapped names."""
+    monitor = monitor or default_monitor()
+    prefix = prefix or type(obj).__name__
+    wrapped: List[str] = []
+    for attr, value in list(vars(obj).items()):
+        if isinstance(value, OrderedLock):
+            continue
+        if isinstance(value, _LOCK_TYPES):
+            name = f"{prefix}.{attr}"
+            setattr(obj, attr, OrderedLock(value, name, monitor))
+            wrapped.append(name)
+    return wrapped
+
+
+def maybe_wrap_locks(obj, prefix: Optional[str] = None) -> List[str]:
+    """The production seam: no-op unless ``TPUDL_DEBUG_LOCK_ORDER`` is
+    set, in which case the object's locks join the process-global
+    monitor (Router/Replica/FleetMonitor call this from __init__)."""
+    if not env_flag("TPUDL_DEBUG_LOCK_ORDER"):
+        return []
+    return wrap_instance_locks(obj, default_monitor(), prefix)

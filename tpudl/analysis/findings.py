@@ -1,0 +1,148 @@
+"""Finding model + ratcheted baseline for the static-analysis tier.
+
+A ``Finding`` is one rule violation at one site. Its ``fingerprint``
+deliberately EXCLUDES the line number: refactors that move code without
+changing the violation keep the same fingerprint, so the checked-in
+``analysis_baseline.json`` survives unrelated edits.
+
+The ratchet contract (scripts/lint_tpudl.py):
+
+- a finding whose fingerprint is IN the baseline **warns** (known debt,
+  each entry carries a one-line justification);
+- a finding NOT in the baseline **fails** the gate — new debt needs a
+  fix or an explicit baseline entry in the same PR;
+- a baseline entry no fingerprint matches anymore is **stale** and
+  warns too: delete it, the ratchet only ever tightens.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, Iterable, List, Optional
+
+#: Severities: P0 = fix before merging (the dogfood bar), P1 = real but
+#: baselinable with a justification, P2 = advisory.
+SEVERITIES = ("P0", "P1", "P2")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    symbol: str  # "Class.method", "function", or "<module>"
+    message: str
+    severity: str = "P1"
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable id for the baseline ratchet: rule + site + message,
+        line number excluded so moved-but-unchanged findings match."""
+        key = "|".join((self.rule, self.path, self.symbol, self.message))
+        return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint,
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "severity": self.severity,
+        }
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.severity}] {self.rule} "
+            f"({self.symbol}): {self.message} [{self.fingerprint}]"
+        )
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    fingerprint: str
+    rule: str
+    path: str
+    symbol: str
+    message: str
+    justification: str
+
+    @classmethod
+    def from_finding(
+        cls, finding: Finding, justification: str
+    ) -> "BaselineEntry":
+        return cls(
+            fingerprint=finding.fingerprint,
+            rule=finding.rule,
+            path=finding.path,
+            symbol=finding.symbol,
+            message=finding.message,
+            justification=justification,
+        )
+
+
+def load_baseline(path: str) -> Dict[str, BaselineEntry]:
+    with open(path) as f:
+        doc = json.load(f)
+    out: Dict[str, BaselineEntry] = {}
+    for row in doc.get("findings", []):
+        entry = BaselineEntry(
+            fingerprint=row["fingerprint"],
+            rule=row.get("rule", "?"),
+            path=row.get("path", "?"),
+            symbol=row.get("symbol", "?"),
+            message=row.get("message", ""),
+            justification=row.get("justification", ""),
+        )
+        out[entry.fingerprint] = entry
+    return out
+
+
+def save_baseline(
+    path: str, entries: Iterable[BaselineEntry]
+) -> None:
+    doc = {
+        "comment": (
+            "Ratcheted baseline for scripts/lint_tpudl.py: findings "
+            "listed here WARN instead of failing the gate. Every entry "
+            "needs a one-line justification; delete entries as the "
+            "debt is paid (stale entries warn)."
+        ),
+        "findings": [dataclasses.asdict(e) for e in entries],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+@dataclasses.dataclass
+class GateResult:
+    new: List[Finding]
+    baselined: List[Finding]
+    stale: List[BaselineEntry]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def apply_baseline(
+    findings: List[Finding],
+    baseline: Optional[Dict[str, BaselineEntry]],
+) -> GateResult:
+    baseline = baseline or {}
+    seen = set()
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for finding in findings:
+        fp = finding.fingerprint
+        if fp in baseline:
+            seen.add(fp)
+            old.append(finding)
+        else:
+            new.append(finding)
+    stale = [e for fp, e in baseline.items() if fp not in seen]
+    return GateResult(new=new, baselined=old, stale=stale)

@@ -1,0 +1,319 @@
+"""Central declaration table for every ``TPUDL_*`` knob and the metric
+naming contract — the single source of truth the registry linter
+(tpudl.analysis.lint) enforces against the tree.
+
+Every environment variable the framework reads is DECLARED here with
+its type, default, and one-line doc; runtime code reads knobs through
+the typed accessors (``env_str`` / ``env_int`` / ``env_float`` /
+``env_flag`` / ``env_require``) instead of raw ``os.environ``. The
+linter flags any raw ``os.environ["TPUDL_*"]`` read outside this
+module, any ``TPUDL_*`` literal that is not declared here, and any
+declared knob missing from the README knob table (which
+``scripts/lint_tpudl.py --knob-table`` generates from this table, so
+docs can never drift from code).
+
+Accessor semantics match the idioms they replaced: an UNSET or
+EMPTY-STRING variable reads as the default (``TPUDL_X= python ...``
+disables a knob the same way unsetting it does), malformed numerics
+raise ``ValueError`` naming the variable, and flags accept
+``1/true/yes/on`` (case-insensitive).
+
+Stdlib-only: this module is imported by ``tpudl.obs.counters`` and the
+runtime bootstrap, so it must not import jax or any tpudl subsystem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Dict, Optional
+
+#: Prometheus-conformant metric name: what ``registry().counter(name)``
+#: / ``.gauge`` / ``.histogram`` literals must match so the /metrics
+#: exposition needs no sanitizing (PR-6 conformance contract — the
+#: exporter appends ``_sum`` / ``_count`` / ``_heartbeat_age_s``
+#: suffixes, so names stay lower_snake_case with no leading digit).
+METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: Characters legal ANYWHERE inside a metric name — the rule applied to
+#: the static fragments of f-string metric names (the dynamic parts are
+#: runtime-sanitized by the call sites, e.g. router's _metric_suffix).
+METRIC_FRAGMENT_RE = re.compile(r"^[a-z0-9_]*$")
+
+_FLAG_TRUTHY = ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str
+    kind: str  # "int" | "float" | "str" | "flag" | "path"
+    default: object
+    help: str
+    #: Owning module (dotted), for the generated table.
+    owner: str
+    #: True for process-coordination variables SET by the framework
+    #: itself (TpuDistributor worker bootstrap) rather than operator
+    #: tuning knobs.
+    internal: bool = False
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def _declare(
+    name: str,
+    kind: str,
+    default,
+    help: str,
+    owner: str,
+    internal: bool = False,
+) -> None:
+    if name in KNOBS:
+        raise ValueError(f"knob {name!r} declared twice")
+    if not name.startswith("TPUDL_"):
+        raise ValueError(f"knob {name!r} must start with TPUDL_")
+    KNOBS[name] = Knob(name, kind, default, help, owner, internal)
+
+
+# --- observability -------------------------------------------------------
+_declare("TPUDL_OBS_DIR", "path", None,
+         "Span/counter JSONL output directory; set = recording on.",
+         "tpudl.obs.spans")
+_declare("TPUDL_OBS_PORT", "int", None,
+         "Live telemetry HTTP port (/metrics, /healthz, /snapshot); "
+         "0 = ephemeral port (test idiom); unset = exporter off.",
+         "tpudl.obs.exporter")
+_declare("TPUDL_OBS_HOST", "str", "127.0.0.1",
+         "Exporter bind host; loopback by default (endpoints are "
+         "unauthenticated), 0.0.0.0 opts into container scraping.",
+         "tpudl.obs.exporter")
+_declare("TPUDL_OBS_HIST_WINDOW", "int", 65_536,
+         "Histogram rolling-window size (bounded memory; cumulative "
+         "count/sum are kept regardless).",
+         "tpudl.obs.counters")
+_declare("TPUDL_OBS_HEARTBEAT_STALE_S", "float", 60.0,
+         "Heartbeat staleness floor for /healthz (the effective "
+         "threshold is cadence-adaptive: max(floor, 5x last interval)).",
+         "tpudl.obs.exporter")
+_declare("TPUDL_PROFILE_DIR", "path", None,
+         "jax.profiler trace output directory for fit(profile=...).",
+         "tpudl.train.loop")
+
+# --- data / dispatch -----------------------------------------------------
+_declare("TPUDL_PREFETCH_DEPTH", "int", None,
+         "Pin the device prefetch queue depth and disable the "
+         "autotuner; unset = autotune.",
+         "tpudl.data.prefetch")
+_declare("TPUDL_OVERLAP_BUCKET_MB", "float", None,
+         "Gradient-accumulation overlap bucket size in MiB; 0 "
+         "disables bucketing; unset = auto (4 MiB buckets on "
+         "multi-shard meshes).",
+         "tpudl.parallel.overlap")
+_declare("TPUDL_COMPILE_CACHE", "path", None,
+         "Persistent XLA compile-cache directory; unset = off.",
+         "tpudl.runtime.compile_cache")
+_declare("TPUDL_NORM_BLOCK_ROWS", "int", None,
+         "Row-block override for the fused norm/MLP-epilogue Pallas "
+         "kernels (benchmarks/fused_epilogue.py --sweep-blocks prints "
+         "the winning pin).",
+         "tpudl.ops.norms")
+_declare("TPUDL_CE_VOCAB_BLOCK", "int", None,
+         "Vocab-block override for the streaming cross-entropy kernel "
+         "(must divide the padded vocab; the sweep keeps the "
+         "divisibility walk).",
+         "tpudl.ops.cross_entropy")
+
+# --- serving -------------------------------------------------------------
+_declare("TPUDL_SERVE_SLOTS", "int", 4,
+         "Default decode slot count for ServeSession.from_model "
+         "(artifact sessions carry theirs in the program batch dim).",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_QUEUE_DEPTH", "int", 256,
+         "Admission queue capacity; overflow sheds shed_capacity.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_PAGED", "flag", False,
+         "Swap the dense fixed-slot KV cache for the paged pool.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_PAGE_SIZE", "int", 16,
+         "Paged KV page size in tokens.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_KV_DTYPE", "str", None,
+         "Paged KV storage dtype (int8 = quantized pages, ~3.5x "
+         "resident slots/byte); unset = the model dtype.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_WEIGHT_DTYPE", "str", None,
+         "Post-training weight quantization for from_model (int8 | "
+         "fp8); unset = full precision.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_PREFIX_SHARE", "flag", False,
+         "Radix prefix-sharing KV: COW page sharing + chunked suffix "
+         "prefill (requires paged).",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_SPEC_K", "int", None,
+         "Speculative-decoding window (draft proposes k tokens per "
+         "verify dispatch); 0/unset = off.",
+         "tpudl.serve.api")
+
+# --- fault tolerance / chaos --------------------------------------------
+_declare("TPUDL_FT_GRACE_S", "float", 15.0,
+         "Preemption grace window (SIGTERM -> emergency checkpoint -> "
+         "hard-exit watchdog).",
+         "tpudl.ft.preemption")
+_declare("TPUDL_FT_MAX_RESTARTS", "int", 3,
+         "Supervisor cohort-restart retry budget.",
+         "tpudl.ft.supervisor")
+_declare("TPUDL_FT_BACKOFF_S", "float", 1.0,
+         "Initial supervisor restart backoff.",
+         "tpudl.ft.supervisor")
+_declare("TPUDL_FT_MAX_BACKOFF_S", "float", 30.0,
+         "Supervisor restart backoff cap.",
+         "tpudl.ft.supervisor")
+_declare("TPUDL_CHAOS_KILL_AT_STEP", "int", None,
+         "Fault injection: SIGKILL the matching rank at step N.",
+         "tpudl.ft.chaos")
+_declare("TPUDL_CHAOS_KILL_RANK", "int", None,
+         "Fault injection: rank to kill (unset = rank 0).",
+         "tpudl.ft.chaos")
+_declare("TPUDL_CHAOS_ONCE_DIR", "path", None,
+         "Fault injection: marker directory making each rank's kill "
+         "fire exactly once across supervised restarts.",
+         "tpudl.ft.chaos")
+_declare("TPUDL_CHAOS_IO_DELAY_S", "float", 0.0,
+         "Fault injection: added per-write delay in the checkpoint "
+         "writer (slow-disk simulation).",
+         "tpudl.ft.chaos")
+
+# --- analysis ------------------------------------------------------------
+_declare("TPUDL_DEBUG_LOCK_ORDER", "flag", False,
+         "Wrap subsystem locks (router/replica/fleet) in the ordered-"
+         "lock monitor: every acquisition is checked against the "
+         "statically derived lock order and the live wait-for graph; "
+         "an inversion raises LockOrderViolation at the acquire site.",
+         "tpudl.analysis.concurrency")
+
+# --- process coordination (set by TpuDistributor, not operators) ---------
+_declare("TPUDL_COORDINATOR", "str", None,
+         "jax.distributed coordinator address for spawned workers.",
+         "tpudl.runtime.distributor", internal=True)
+_declare("TPUDL_NUM_PROCESSES", "int", None,
+         "World size handed to spawned workers.",
+         "tpudl.runtime.distributor", internal=True)
+_declare("TPUDL_PROCESS_ID", "int", 0,
+         "This worker's rank (also tags span streams).",
+         "tpudl.runtime.distributor", internal=True)
+_declare("TPUDL_PLATFORM", "str", None,
+         "Backend platform override for spawned workers (cpu/tpu).",
+         "tpudl.runtime.distributor", internal=True)
+
+
+class UnknownKnobError(KeyError):
+    """A knob read that is not declared in the table — declare it in
+    tpudl.analysis.registry before reading it."""
+
+
+def _lookup(name: str) -> Knob:
+    knob = KNOBS.get(name)
+    if knob is None:
+        raise UnknownKnobError(
+            f"{name!r} is not a declared TPUDL knob — add it to "
+            f"tpudl.analysis.registry.KNOBS"
+        )
+    return knob
+
+
+def env_raw(name: str) -> Optional[str]:
+    """The raw string value, or None when unset OR empty (an empty
+    assignment disables a knob the same way unsetting it does)."""
+    _lookup(name)
+    raw = os.environ.get(name)
+    return raw if raw else None
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    raw = env_raw(name)
+    return raw if raw is not None else default
+
+
+def env_require(name: str) -> str:
+    """A coordination variable the caller cannot run without (worker
+    bootstrap); raises KeyError naming it when missing."""
+    raw = env_raw(name)
+    if raw is None:
+        raise KeyError(f"required environment variable {name} is not set")
+    return raw
+
+
+def env_int(
+    name: str,
+    default: Optional[int] = None,
+    min_value: Optional[int] = None,
+    required: bool = False,
+) -> Optional[int]:
+    raw = env_raw(name)
+    if raw is None:
+        if required:
+            raise KeyError(
+                f"required environment variable {name} is not set"
+            )
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {raw!r}"
+        ) from None
+    if min_value is not None and value < min_value:
+        raise ValueError(f"{name} must be >= {min_value}, got {value}")
+    return value
+
+
+def env_float(
+    name: str, default: Optional[float] = None
+) -> Optional[float]:
+    raw = env_raw(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number, got {raw!r}"
+        ) from None
+
+
+def env_flag(name: str) -> bool:
+    raw = env_raw(name)
+    return raw is not None and raw.strip().lower() in _FLAG_TRUTHY
+
+
+def knob_table_markdown(include_internal: bool = True) -> str:
+    """The env-knob reference table, generated from the declaration
+    table — ``scripts/lint_tpudl.py --knob-table`` prints this, and the
+    README embeds it between ``<!-- knob-table:begin/end -->`` markers
+    (tests/test_analysis.py asserts they match, so the docs cannot
+    drift from the code)."""
+    lines = [
+        "| Knob | Type | Default | What it does |",
+        "| --- | --- | --- | --- |",
+    ]
+    internal_lines: list = []
+    for name in sorted(KNOBS):
+        knob = KNOBS[name]
+        default = "unset" if knob.default is None else str(knob.default)
+        row = (
+            f"| `{knob.name}` | {knob.kind} | {default} | "
+            f"{knob.help} (`{knob.owner}`) |"
+        )
+        (internal_lines if knob.internal else lines).append(row)
+    if include_internal and internal_lines:
+        lines.append(
+            "\nSet by the framework itself (TpuDistributor worker "
+            "bootstrap), not operator knobs:\n"
+        )
+        lines.append("| Variable | Type | Default | What it does |")
+        lines.append("| --- | --- | --- | --- |")
+        lines.extend(internal_lines)
+    return "\n".join(lines) + "\n"

@@ -50,10 +50,10 @@ windowed p95 exceeds a threshold, within a device-memory byte budget.
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 import weakref
+from tpudl.analysis.registry import env_int
 from typing import Callable, Dict, Iterator, Optional
 
 from tpudl.obs.counters import percentile
@@ -688,10 +688,10 @@ def prefetch_to_device(
     threads; abandonment without close is reaped by a finalizer on the
     handle.
     """
-    env_depth = os.environ.get("TPUDL_PREFETCH_DEPTH")
+    env_depth = env_int("TPUDL_PREFETCH_DEPTH")
     autotuner = None
     if env_depth is not None:
-        prefetch = max(1, int(env_depth))
+        prefetch = max(1, env_depth)
     elif autotune or autotune is None:
         autotuner = PrefetchAutotuner(
             depth=max(1, prefetch),

@@ -28,6 +28,7 @@ import signal
 import time
 from typing import Callable, Optional
 
+from tpudl.analysis.registry import env_float, env_int, env_str
 from tpudl.ft.store import COMMIT_MARKER, PAYLOAD_FILE, CheckpointStore
 
 ENV_KILL_AT_STEP = "TPUDL_CHAOS_KILL_AT_STEP"
@@ -58,7 +59,7 @@ def step_killer(
     def hook(step: int) -> None:
         if step < kill_at_step:
             return
-        me = int(os.environ.get("TPUDL_PROCESS_ID", "0"))
+        me = env_int("TPUDL_PROCESS_ID", 0)
         if rank is not None and me != rank:
             return
         if once_dir is not None:
@@ -79,14 +80,13 @@ def step_killer(
 def step_kill_hook() -> Optional[Callable[[int], None]]:
     """Env-driven ``step_killer`` for spawned workers; None when chaos
     is off (the default)."""
-    raw = os.environ.get(ENV_KILL_AT_STEP)
-    if not raw:
+    kill_at = env_int(ENV_KILL_AT_STEP)
+    if kill_at is None:
         return None
-    rank_raw = os.environ.get(ENV_KILL_RANK)
     return step_killer(
-        int(raw),
-        rank=int(rank_raw) if rank_raw not in (None, "") else None,
-        once_dir=os.environ.get(ENV_ONCE_DIR) or None,
+        kill_at,
+        rank=env_int(ENV_KILL_RANK),
+        once_dir=env_str(ENV_ONCE_DIR),
     )
 
 
@@ -125,7 +125,7 @@ def remove_commit_marker(directory: str, step: int) -> None:
 
 
 def io_delay_s() -> float:
-    return float(os.environ.get(ENV_IO_DELAY_S, "0") or 0)
+    return env_float(ENV_IO_DELAY_S, 0.0)
 
 
 def io_delay_hook() -> Optional[Callable[[], None]]:

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Iterable, Optional
 
+from tpudl.analysis.registry import env_float
+
 #: Exit code of a hard grace-window exit (128 + SIGTERM) — launchers
 #: classify it as preemption, not a crash.
 PREEMPTED_EXIT_CODE = 143
@@ -49,7 +51,7 @@ _installed: dict = {}
 
 
 def default_grace_s() -> float:
-    return float(os.environ.get("TPUDL_FT_GRACE_S", "15") or 15)
+    return env_float("TPUDL_FT_GRACE_S", 15.0)
 
 
 def requested() -> bool:

@@ -27,10 +27,10 @@ backoff, default 1.0), ``TPUDL_FT_MAX_BACKOFF_S`` (cap, default 30).
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 from typing import Any, Callable, List, Optional
 
+from tpudl.analysis import registry
 from tpudl.obs import counters as obs_counters
 from tpudl.obs import spans as obs_spans
 
@@ -45,11 +45,11 @@ class SupervisorGaveUp(RuntimeError):
 
 
 def _env_float(name: str, default: float) -> float:
-    return float(os.environ.get(name, "") or default)
+    return registry.env_float(name, default)
 
 
 def _env_int(name: str, default: int) -> int:
-    return int(os.environ.get(name, "") or default)
+    return registry.env_int(name, default)
 
 
 @dataclasses.dataclass

@@ -14,8 +14,9 @@ record (``SpanRecorder.counters``), so one file carries both."""
 from __future__ import annotations
 
 import math
-import os
 import threading
+
+from tpudl.analysis.registry import env_int
 from typing import Dict, List, Optional
 
 #: Default rolling-window size for Histogram (see TPUDL_OBS_HIST_WINDOW).
@@ -95,9 +96,7 @@ class Histogram:
 
     def __init__(self, window: Optional[int] = None):
         if window is None:
-            window = int(
-                os.environ.get("TPUDL_OBS_HIST_WINDOW", DEFAULT_HIST_WINDOW)
-            )
+            window = env_int("TPUDL_OBS_HIST_WINDOW", DEFAULT_HIST_WINDOW)
         if window < 1:
             raise ValueError(f"histogram window must be >= 1, got {window}")
         self._lock = threading.Lock()

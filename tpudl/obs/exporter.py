@@ -49,6 +49,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
+from tpudl.analysis.registry import env_float, env_int, env_str
 from tpudl.obs import counters as obs_counters
 from tpudl.obs import spans as obs_spans
 
@@ -101,10 +102,8 @@ class Heartbeat:
         adaptive_factor: float = 5.0,
     ):
         if stale_after is None:
-            stale_after = float(
-                os.environ.get(
-                    "TPUDL_OBS_HEARTBEAT_STALE_S", DEFAULT_HEARTBEAT_STALE_S
-                )
+            stale_after = env_float(
+                "TPUDL_OBS_HEARTBEAT_STALE_S", DEFAULT_HEARTBEAT_STALE_S
             )
         self.name = name
         self.stale_after = stale_after
@@ -504,7 +503,7 @@ def start_exporter(
     if _active is not None and _active.running:
         return _active
     if host is None:
-        host = os.environ.get("TPUDL_OBS_HOST", "127.0.0.1")
+        host = env_str("TPUDL_OBS_HOST", "127.0.0.1")
     _active = ObsExporter(port=port, host=host).start()
     if not _atexit_registered:
         atexit.register(stop_exporter)
@@ -536,15 +535,9 @@ def maybe_start_from_env() -> Optional[ObsExporter]:
     ``start_exporter()``/``ObsExporter.start()`` still raises."""
     if _active is not None and _active.running:
         return _active
-    raw = os.environ.get("TPUDL_OBS_PORT")
-    if raw is None or raw == "":
+    port = env_int("TPUDL_OBS_PORT")
+    if port is None:
         return None
-    try:
-        port = int(raw)
-    except ValueError:
-        raise ValueError(
-            f"TPUDL_OBS_PORT must be an integer port, got {raw!r}"
-        ) from None
     try:
         return start_exporter(port=port)
     except OSError as e:

@@ -45,6 +45,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Union
 
+from tpudl.analysis.concurrency import maybe_wrap_locks
 from tpudl.obs.exporter import _QUANTILES, _fmt, _metric_name, format_labels
 from tpudl.obs.spans import read_jsonl
 
@@ -158,6 +159,7 @@ class FleetMonitor:
         self.scrape_timeout_s = scrape_timeout_s
         self.clock = clock
         self._lock = threading.RLock()
+        maybe_wrap_locks(self)
         self._state: Dict[str, dict] = {
             name: {
                 "ok": False,

@@ -43,6 +43,8 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from tpudl.analysis.registry import env_int, env_str
+
 #: Span categories the goodput classifier understands (see
 #: tpudl.obs.goodput). Instrumentation may invent others; they land in
 #: the report's "other" bucket.
@@ -141,7 +143,7 @@ class SpanRecorder:
         self.process = (
             process
             if process is not None
-            else int(os.environ.get("TPUDL_PROCESS_ID", "0"))
+            else env_int("TPUDL_PROCESS_ID", 0)
         )
         self._lock = threading.Lock()
         self._records: list = []
@@ -336,7 +338,7 @@ def default_span_path(directory: str) -> str:
     collision-free when a distributor parent and its rank-0 worker share
     the directory."""
     host = socket.gethostname()
-    proc = int(os.environ.get("TPUDL_PROCESS_ID", "0"))
+    proc = env_int("TPUDL_PROCESS_ID", 0)
     return os.path.join(
         directory, f"spans-{host}-p{proc}-{os.getpid()}.jsonl"
     )
@@ -378,7 +380,7 @@ def active_recorder() -> Optional[SpanRecorder]:
     which is the branch every hot path takes for free."""
     if _active is not None:
         return _active
-    obs_dir = os.environ.get("TPUDL_OBS_DIR")
+    obs_dir = env_str("TPUDL_OBS_DIR")
     if obs_dir:
         return enable(obs_dir)
     return None

@@ -52,11 +52,9 @@ VOCAB_BLOCK_OVERRIDE: Optional[int] = None
 def _fit_vocab_block(v_pad: int, limit: int = 1024) -> int:
     override = VOCAB_BLOCK_OVERRIDE
     if override is None:
-        import os
+        from tpudl.analysis.registry import env_int
 
-        raw = os.environ.get("TPUDL_CE_VOCAB_BLOCK")
-        if raw:
-            override = int(raw)
+        override = env_int("TPUDL_CE_VOCAB_BLOCK")
     if override is not None:
         if override < 128:
             raise ValueError(

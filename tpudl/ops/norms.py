@@ -89,11 +89,9 @@ def _block_rows(n: int, h_pad: int, itemsize: int) -> int:
     capped so one (rows, h_pad) block stays ~1 MB."""
     override = BLOCK_ROWS_OVERRIDE
     if override is None:
-        import os
+        from tpudl.analysis.registry import env_int
 
-        raw = os.environ.get("TPUDL_NORM_BLOCK_ROWS")
-        if raw:
-            override = int(raw)
+        override = env_int("TPUDL_NORM_BLOCK_ROWS")
     if override is not None:
         if override < 1:
             raise ValueError(

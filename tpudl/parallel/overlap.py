@@ -31,7 +31,7 @@ compiled step).
 
 from __future__ import annotations
 
-import os
+from tpudl.analysis.registry import env_float
 from typing import List, Optional, Sequence
 
 import jax
@@ -49,9 +49,8 @@ def bucket_bytes_from_env(default: Optional[int] = None) -> Optional[int]:
     """Resolve the bucket size: ``TPUDL_OVERLAP_BUCKET_MB`` wins, else
     ``default`` (None -> DEFAULT_BUCKET_BYTES). Returns None when the
     knob disables bucketing (``0``)."""
-    env = os.environ.get(_ENV_KNOB)
-    if env is not None:
-        mb = float(env)
+    mb = env_float(_ENV_KNOB)
+    if mb is not None:
         if mb <= 0:
             return None
         return int(mb * (1 << 20))
@@ -124,9 +123,8 @@ def accumulate(acc, new, bucket_bytes: Optional[int] = None):
         if resolved <= 0:
             return jax.tree.map(jax.numpy.add, acc, new)
     else:
-        env = os.environ.get(_ENV_KNOB)
-        if env is not None:
-            mb = float(env)
+        mb = env_float(_ENV_KNOB)
+        if mb is not None:
             if mb <= 0:
                 return jax.tree.map(jax.numpy.add, acc, new)
             resolved = int(mb * (1 << 20))

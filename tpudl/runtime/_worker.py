@@ -10,13 +10,17 @@ import pickle
 import sys
 import traceback
 
+from tpudl.analysis.registry import env_require, env_str
+
 
 def main() -> int:
     payload_path, result_path = sys.argv[1], sys.argv[2]
-    coord = os.environ["TPUDL_COORDINATOR"]
-    nproc = int(os.environ["TPUDL_NUM_PROCESSES"])
-    pid = int(os.environ["TPUDL_PROCESS_ID"])
-    platform = os.environ.get("TPUDL_PLATFORM", "cpu")
+    from tpudl.analysis.registry import env_int
+
+    coord = env_require("TPUDL_COORDINATOR")
+    nproc = env_int("TPUDL_NUM_PROCESSES", required=True)
+    pid = env_int("TPUDL_PROCESS_ID", required=True)
+    platform = env_str("TPUDL_PLATFORM", "cpu")
 
     import jax
 
@@ -30,10 +34,11 @@ def main() -> int:
     # instrumented layer — per-rank wall-clock is what the straggler
     # report attributes.
     rec = None
-    if os.environ.get("TPUDL_OBS_DIR"):
+    obs_dir = env_str("TPUDL_OBS_DIR")
+    if obs_dir:
         from tpudl.obs import spans as obs_spans
 
-        rec = obs_spans.enable(os.environ["TPUDL_OBS_DIR"], process=pid)
+        rec = obs_spans.enable(obs_dir, process=pid)
 
     t0 = rec.clock() if rec is not None else 0.0
     try:

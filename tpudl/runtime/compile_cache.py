@@ -23,8 +23,9 @@ from disk.
 
 from __future__ import annotations
 
-import os
 from typing import Optional
+
+from tpudl.analysis.registry import env_str
 
 _ENV = "TPUDL_COMPILE_CACHE"
 _HIT_EVENT = "/jax/compilation_cache/cache_hits"
@@ -55,7 +56,7 @@ def enable_compile_cache(path: Optional[str] = None) -> bool:
     monitoring listener installs once per process."""
     global _listener_installed
     if path is None:
-        path = os.environ.get(_ENV)
+        path = env_str(_ENV)
     if not path:
         return False
     import jax

@@ -35,6 +35,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from tpudl.analysis.registry import env_int
 from typing import Any, Callable, List, Optional, Sequence
 
 from tpudl.obs import exporter as obs_exporter
@@ -186,7 +187,7 @@ class TpuDistributor:
                 jax.distributed.initialize(
                     self.coordinator_address,
                     num_processes=self.num_processes,
-                    process_id=int(os.environ.get("TPUDL_PROCESS_ID", "0")),
+                    process_id=env_int("TPUDL_PROCESS_ID", 0),
                 )
             else:
                 jax.distributed.initialize()

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
+from tpudl.analysis.registry import env_str
 from typing import Optional, Sequence
 
 import jax
@@ -150,7 +150,7 @@ def apply_platform_env() -> None:
     CPU backend, typically with
     XLA_FLAGS=--xla_force_host_platform_device_count=8 for a fake mesh.
     """
-    platform = os.environ.get("TPUDL_PLATFORM")
+    platform = env_str("TPUDL_PLATFORM")
     if platform:
         jax.config.update("jax_platforms", platform)
 

@@ -41,7 +41,6 @@ byte-identical to the ``Result.tokens`` submit/collect returns.
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -50,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpudl.analysis.registry import env_flag, env_int, env_str
 from tpudl.obs import registry
 from tpudl.obs.spans import active_recorder
 from tpudl.serve.cache import SlotCache
@@ -166,16 +166,7 @@ def _find_pool(tree) -> Optional[dict]:
 
 
 def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        value = int(raw)
-    except ValueError:
-        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
-    if value < 1:
-        raise ValueError(f"{name} must be >= 1, got {value}")
-    return value
+    return env_int(name, default, min_value=1)
 
 
 class ServeSession:
@@ -307,9 +298,7 @@ class ServeSession:
         )
 
         if weight_dtype is None:
-            weight_dtype = (
-                os.environ.get("TPUDL_SERVE_WEIGHT_DTYPE") or None
-            )
+            weight_dtype = env_str("TPUDL_SERVE_WEIGHT_DTYPE")
         if weight_dtype is not None:
             from tpudl.quant import quantize_model
 
@@ -322,16 +311,11 @@ class ServeSession:
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if paged is None:
-            paged = os.environ.get("TPUDL_SERVE_PAGED", "") in (
-                "1", "true", "yes"
-            )
+            paged = env_flag("TPUDL_SERVE_PAGED")
         if prefix_share is None:
-            prefix_share = os.environ.get(
-                "TPUDL_SERVE_PREFIX_SHARE", ""
-            ) in ("1", "true", "yes")
+            prefix_share = env_flag("TPUDL_SERVE_PREFIX_SHARE")
         if spec_k is None:
-            raw = os.environ.get("TPUDL_SERVE_SPEC_K")
-            spec_k = int(raw) if raw else None
+            spec_k = env_int("TPUDL_SERVE_SPEC_K")
             if spec_k == 0:
                 spec_k = None
         pf = prefill_fn(model)
@@ -344,7 +328,7 @@ class ServeSession:
             from tpudl.serve.cache import PagedKVCache
 
             if kv_dtype is None:
-                kv_dtype = os.environ.get("TPUDL_SERVE_KV_DTYPE") or None
+                kv_dtype = env_str("TPUDL_SERVE_KV_DTYPE")
             cache = PagedKVCache(
                 cache_template,
                 page_size=(

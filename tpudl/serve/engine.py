@@ -118,7 +118,7 @@ def first_token(logits, request) -> int:
         )
     else:
         sel = _select_greedy(logits)
-    return int(np.asarray(sel)[0])
+    return int(jax.device_get(sel)[0])
 
 
 class _Prefilled:
@@ -696,10 +696,15 @@ class Engine:
             logits, self.cache.cache = self.decode_call(
                 self.params, self.cache.cache, tokens, positions
             )
+        # Explicit readback (jax.device_get, not an implicit
+        # np.asarray): the per-step token sync is the ONE intended
+        # d2h in the decode steady state, and the dispatch-hygiene
+        # audit (tpudl.analysis.assert_no_host_transfers) disallows
+        # implicit transfers — intent made visible is the contract.
         if temps.any():
-            sel = np.asarray(_select_tokens(logits, temps, seeds, steps))
+            sel = jax.device_get(_select_tokens(logits, temps, seeds, steps))
         else:
-            sel = np.asarray(_select_greedy(logits))
+            sel = jax.device_get(_select_greedy(logits))
         if self.paged:
             # Each ACTIVE slot's logical length advanced by one (idle
             # slots stay pinned on the trash page).
@@ -778,10 +783,10 @@ class Engine:
         )
         sampling = any(temps[i] > 0 for i in active)
         if sampling:
-            host_logits = np.asarray(logits, np.float32)
+            host_logits = np.asarray(jax.device_get(logits), np.float32)
             target_choice = host_logits.argmax(axis=-1).astype(np.int32)
         else:
-            target_choice = np.asarray(_select_greedy(logits))
+            target_choice = jax.device_get(_select_greedy(logits))
         now = self.clock()
         total_emitted = 0
         total_accepted = 0

@@ -63,6 +63,7 @@ import urllib.request
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from tpudl.analysis.concurrency import maybe_wrap_locks
 from tpudl.obs import registry
 from tpudl.obs.spans import active_recorder
 from tpudl.serve.api import Request, Result, ServeSession, validate_request
@@ -97,6 +98,7 @@ class Replica:
         self._inbox: deque = deque()
         self._results: Dict[Any, Result] = {}
         self._results_lock = threading.Lock()
+        maybe_wrap_locks(self)
         #: rid -> measured inbox wait (seconds), popped when the result
         #: is harvested: the router-door -> engine-admission hop of the
         #: stitched fleet trace (router TTFT = inbox wait + engine
@@ -553,6 +555,11 @@ class Router:
         # forever. Reentrant because _failover resubmits through
         # submit() and placement sheds through _shed().
         self._books = threading.RLock()
+        # TPUDL_DEBUG_LOCK_ORDER: the books join the process-global
+        # ordered-lock monitor (the live companion of the static pass —
+        # cross-object cycles like books->replica-results vs
+        # results->books are only visible at runtime).
+        maybe_wrap_locks(self)
         self._ready: Dict[str, bool] = {r.name: True for r in replicas}
         # Replicas being drained for removal: still scraped, harvested,
         # and failed over, but they take NO new placements — the
@@ -581,17 +588,22 @@ class Router:
     # -- SLO / health wiring -------------------------------------------
 
     def _subscribe_slo(self, name: str, monitor) -> None:
-        self._burning[name] = frozenset()
+        with self._books:
+            self._burning[name] = frozenset()
 
         def _on_transition(objective, state):
-            prev = self._burning.get(name, frozenset())
-            if state["burning"]:
-                self._burning[name] = prev | {objective.name}
-            else:
-                self._burning[name] = prev - {objective.name}
-            registry().gauge("serve_router_burning_replicas").set(
-                sum(1 for b in self._burning.values() if b)
-            )
+            # SLO transitions fire on the monitor's evaluating thread
+            # (replica/engine side): _burning is a routing book like
+            # _assigned, and remove_replica mutates it from the
+            # autoscaler's thread — same lock, same discipline.
+            with self._books:
+                prev = self._burning.get(name, frozenset())
+                if state["burning"]:
+                    self._burning[name] = prev | {objective.name}
+                else:
+                    self._burning[name] = prev - {objective.name}
+                burning = sum(1 for b in self._burning.values() if b)
+            registry().gauge("serve_router_burning_replicas").set(burning)
 
         monitor.subscribe(_on_transition)
 
@@ -650,13 +662,24 @@ class Router:
         # the list from the autoscaler's thread.
         with self._books:
             replicas = list(self.replicas)
-        for replica in replicas:
-            h = replica.scrape()
-            ready = bool(h.get("healthy", True))
-            if self._ready.get(replica.name) and not ready:
-                newly_down.append(replica.name)
-            self._ready[replica.name] = ready
-            self._last_health[replica.name] = h
+        # Scrapes can block on real HTTP — run them OUTSIDE the books,
+        # then apply the results under them: _ready/_last_health are
+        # routing books (add_replica/remove_replica mutate them from
+        # the autoscaler's thread, load_report reads them under _books)
+        # and an unguarded store here races both.
+        scraped = [
+            (replica, h, bool(h.get("healthy", True)))
+            for replica in replicas
+            for h in [replica.scrape()]
+        ]
+        with self._books:
+            for replica, h, ready in scraped:
+                if self._ready.get(replica.name) and not ready:
+                    newly_down.append(replica.name)
+                self._ready[replica.name] = ready
+                self._last_health[replica.name] = h
+            ready_count = sum(1 for v in self._ready.values() if v)
+        for replica, h, ready in scraped:
             suffix = _metric_suffix(replica.name)
             reg.gauge(f"serve_replica_{suffix}_ready").set(int(ready))
             reg.gauge(f"serve_replica_{suffix}_slots_busy").set(
@@ -665,9 +688,7 @@ class Router:
             reg.gauge(f"serve_replica_{suffix}_queue_depth").set(
                 h.get("queue_depth", 0)
             )
-        reg.gauge("serve_router_ready_replicas").set(
-            sum(1 for v in self._ready.values() if v)
-        )
+        reg.gauge("serve_router_ready_replicas").set(ready_count)
         reg.gauge("serve_router_total_replicas").set(len(replicas))
         reg.gauge("serve_router_autoscale_hint").set(self._autoscale_hint())
         for name in newly_down:

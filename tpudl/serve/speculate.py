@@ -52,6 +52,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 
@@ -231,7 +232,7 @@ class Speculator:
                 *self.cache.dispatch_args(),
             )
             if sampling:
-                host = np.asarray(logits, np.float32)
+                host = np.asarray(jax.device_get(logits), np.float32)
                 sel = np.argmax(host, axis=-1).astype(np.int32)
                 for i in sampling:
                     q = softmax(host[i], float(temps[i]))
@@ -245,7 +246,7 @@ class Speculator:
             else:
                 from tpudl.serve.engine import _select_greedy
 
-                sel = np.asarray(_select_greedy(logits))
+                sel = jax.device_get(_select_greedy(logits))
             self.cache.advance(active)
             proposals[:, j] = sel
             cur_tok = sel

@@ -13,7 +13,7 @@ booleans to stdout; SURVEY.md §5.5). Here metrics flow through one
 - the tpudl.obs span stream, when observability is enabled: each log
   call lands as a {"kind": "event", "name": "metrics"} record in the
   run's span JSONL (so ONE artifact carries spans, counters, and
-  training metrics) and sets metric.<name> gauges in the counters
+  training metrics) and sets metric_<name> gauges in the counters
   registry.
 
 `MetricLogger.__call__(step, metrics)` matches the `logger=` callback
@@ -79,7 +79,7 @@ class MetricLogger:
             rec.event("metrics", cat="metrics", step=step, metrics=scalars)
             reg = obs_counters.registry()
             for k, v in scalars.items():
-                reg.gauge(f"metric.{k}").set(v)
+                reg.gauge(f"metric_{k}").set(v)
 
     def close(self) -> None:
         if self._jsonl is not None:

@@ -793,9 +793,9 @@ def fit(
     Disabled (the default) costs one env lookup per fit() call and
     nothing per step.
     """
-    import os
+    from tpudl.analysis.registry import env_str
 
-    profile_dir = profile_dir or os.environ.get("TPUDL_PROFILE_DIR")
+    profile_dir = profile_dir or env_str("TPUDL_PROFILE_DIR")
     prof_start, prof_stop = profile_window
     profiling = False
     prof_done = False  # one trace per fit: no restart after the window
