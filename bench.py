@@ -501,6 +501,19 @@ def _bench_block_pins():
     return {"per_family": best, "pins": pins, "command": command}
 
 
+def _bench_chaos():
+    """Serving fault tolerance (tpudl.serve migration + chaos via
+    benchmarks/serve_load.py --chaos): p99 latency of draining a
+    loaded replica (page-granular KV migration makes it ~payload
+    transfer, asserted < 10% of the longest in-flight generation) and
+    the median client-visible token gap across a mid-decode replica
+    preemption (zero re-prefill, generate()-parity asserted inside the
+    benchmark). Banked from r08 onward (lower is better for both)."""
+    from benchmarks.serve_load import measure_chaos
+
+    return measure_chaos()
+
+
 def _bench_ft():
     """Fault-tolerance costs (benchmarks/ft_recovery.py): the async
     checkpoint's on-step stall and the kill-to-first-post-restart-step
@@ -615,6 +628,15 @@ def main(argv=None):
         print("fleet autoscale bench failed:", file=sys.stderr)
         traceback.print_exc()
         fleet = {}
+    try:
+        chaos_tier = _bench_chaos()
+    except Exception:
+        import sys
+        import traceback
+
+        print("serve chaos bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        chaos_tier = {}
     try:
         ft = _bench_ft()
     except Exception:
@@ -766,6 +788,15 @@ def main(argv=None):
         "autoscale_recovery_s": fleet.get("autoscale_recovery_s"),
         "fleet_scrape_overhead_ms": fleet.get(
             "fleet_scrape_overhead_ms"
+        ),
+        # Serving fault tolerance (tpudl.serve KV migration + chaos
+        # harness via benchmarks/serve_load.py --chaos): p99 drain of
+        # a loaded replica (migration-based — ~transfer time, not the
+        # longest generation) and the median failover token gap a
+        # client sees across a mid-decode preemption.
+        "serve_drain_p99_ms": chaos_tier.get("serve_drain_p99_ms"),
+        "failover_token_gap_ms": chaos_tier.get(
+            "failover_token_gap_ms"
         ),
         # Fault tolerance (tpudl.ft via benchmarks/
         # ft_recovery.py): the async checkpoint's mean on-step

@@ -1200,6 +1200,214 @@ def measure_fleet() -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Chaos: migration-first failover + instant drains (--chaos)
+# ---------------------------------------------------------------------------
+
+
+def _warm_migration(programs) -> None:
+    """Compile + warm the migration gather/scatter programs (module-
+    level jits shared by every cache of this geometry) so a chaos
+    window never times out on a first-call XLA compile."""
+    from tpudl.serve import Request
+
+    src = session_from_programs(programs)
+    src.submit(Request("warm_mig", [1, 2, 3], max_new_tokens=4))
+    for _ in range(2):
+        src.engine.step()
+    payload = src.engine.export_request("warm_mig")
+    dst = session_from_programs(programs)
+    dst.engine.install_migrated(payload)
+    while dst.engine.step():
+        pass
+
+
+def run_chaos(
+    n_requests: int = 18,
+    num_replicas: int = 3,
+    sim_step_ms: float = 15.0,
+    num_slots: int = 4,
+    seed: int = 0,
+    preempt_at_step: int = 8,
+    drains: int = 3,
+    drain_requests: int = 4,
+    drain_tokens: int = 120,
+    check: bool = True,
+) -> dict:
+    """The ``--chaos`` scenario, two acceptance halves.
+
+    **Failover (zero re-prefill).** Open-loop-ish ragged load on an
+    N-replica paged router; one replica is chaos-PREEMPTED mid-decode
+    (``tpudl.serve.chaos.step_preempter`` — lame duck: unready, thread
+    answering). Every in-flight request must complete on survivors
+    with solo-``generate()`` parity, the fleet-wide prefill count must
+    equal the request count (migration re-pays ZERO prefills), and the
+    ``serve_failover_token_gap_ms`` histogram carries the client-
+    visible stall — the ``failover_token_gap_ms`` bench key.
+
+    **Drain (instant).** ``drains`` rounds of: load a 2-replica fleet
+    with all-long generations, then time ``remove_replica(drain=True)``
+    mid-stream. In-flight KV migrates, so the p99 drain must come in
+    under 10% of the time the longest in-flight generation still
+    needed (the sim-device bound) — the ``serve_drain_p99_ms`` key.
+    """
+    import jax.numpy as jnp
+
+    from tpudl.export.latency import LatencyStats
+    from tpudl.models.generate import generate
+    from tpudl.obs import registry
+    from tpudl.serve import Replica, Router, chaos
+
+    sim_step_s = 1e-3 * sim_step_ms
+    programs = build_programs(num_slots, paged=True)
+    warm = session_from_programs(programs)
+    warmup_session(warm)
+    _warm_migration(programs)
+
+    # -- half A: preempt one replica mid-decode under load -------------
+    sessions = [
+        session_from_programs(programs, sim_step_s=sim_step_s)
+        for _ in range(num_replicas)
+    ]
+    replicas = [Replica(f"c{i}", s) for i, s in enumerate(sessions)]
+    sessions[1].engine.chaos_hooks.append(
+        chaos.step_preempter(preempt_at_step)
+    )
+    requests = make_requests(n_requests, seed)
+    gap_before = registry().snapshot()["histograms"].get(
+        "serve_failover_token_gap_ms", {}
+    ).get("count", 0)
+    with Router(replicas, scrape_interval_s=0.0) as router:
+        t0 = time.perf_counter()
+        for request in requests:
+            router.submit(request)
+            time.sleep(0.004)  # trickle, so the kill lands mid-stream
+        results = router.collect(timeout_s=600.0)
+        elapsed = time.perf_counter() - t0
+        migrations = router.num_migrations
+        failovers = router.num_failovers
+    total_prefills = sum(s.engine.num_prefills for s in sessions)
+    stats = _latency_stats(results)
+    gap_hist = registry().snapshot()["histograms"].get(
+        "serve_failover_token_gap_ms", {}
+    )
+    if check:
+        assert replicas[1].lame, "the chaos preemption never fired"
+        assert migrations >= 1, "failover never used the migration path"
+        assert all(r.ok for r in results.values()), {
+            rid: r.finish_reason for rid, r in results.items() if not r.ok
+        }
+        assert total_prefills == len(requests), (
+            f"{total_prefills} prefills for {len(requests)} requests — "
+            f"failover re-paid prefill instead of migrating"
+        )
+        for request in requests:
+            want = np.asarray(
+                generate(
+                    programs["model"], programs["params"],
+                    jnp.asarray(request.input_ids, jnp.int32)[None, :],
+                    max_new_tokens=request.max_new_tokens,
+                )
+            )[0]
+            got = np.asarray(results[request.request_id].tokens)
+            np.testing.assert_array_equal(
+                got, want[: got.shape[0]],
+                err_msg=f"{request.request_id} diverged across failover",
+            )
+        assert gap_hist.get("count", 0) > gap_before, (
+            "no failover token gap was observed"
+        )
+    failover_half = {
+        "requests": n_requests,
+        "replicas": num_replicas,
+        "wall_s": round(elapsed, 4),
+        "migrations": migrations,
+        "failover_resubmissions": failovers,
+        "total_prefills": total_prefills,
+        "token_gap_p50_ms": gap_hist.get("p50"),
+        "token_gap_p99_ms": gap_hist.get("p99"),
+        **{f"completed_{k}": v for k, v in stats.items()
+           if k in ("completed", "shed", "tokens")},
+    }
+
+    # -- half B: timed drains of a loaded replica ----------------------
+    from tpudl.serve import Request
+
+    drain_ms: List[float] = []
+    longest_gen_ms = drain_tokens * sim_step_ms
+    for i in range(drains):
+        d_sessions = [
+            session_from_programs(programs, sim_step_s=sim_step_s)
+            for _ in range(2)
+        ]
+        d_replicas = [
+            Replica(f"dr{i}_{j}", s) for j, s in enumerate(d_sessions)
+        ]
+        # Uniform LONG generations (drain_tokens x sim step): the
+        # yardstick the drain races is unambiguous, and long enough
+        # that 1-vCPU command-pickup jitter (the replica loop answers
+        # between engine iterations) stays well inside the 10% bar.
+        d_requests = [
+            Request(f"dl{i}_{j}", [3, 5, 7 + j],
+                    max_new_tokens=drain_tokens)
+            for j in range(drain_requests)
+        ]
+        with Router(d_replicas, scrape_interval_s=0.0) as d_router:
+            for request in d_requests:
+                d_router.submit(request)
+            # Let the seating burst finish (a loop iteration seating N
+            # fresh requests runs N sim-latency prefills, and the drain
+            # command waits out the iteration in flight) — the timed
+            # drain then measures steady mid-stream evacuation, ~25% of
+            # the way into 40-token generations.
+            time.sleep(10 * sim_step_s)
+            t0 = time.perf_counter()
+            d_router.remove_replica(
+                f"dr{i}_0", drain=True, timeout_s=120.0
+            )
+            drain_ms.append(1e3 * (time.perf_counter() - t0))
+            d_results = d_router.collect(timeout_s=600.0)
+        if check:
+            assert set(d_results) == {
+                r.request_id for r in d_requests
+            }, "a drain dropped requests"
+            assert all(r.ok for r in d_results.values()), {
+                rid: r.finish_reason
+                for rid, r in d_results.items() if not r.ok
+            }
+    drain_p99 = LatencyStats.from_ms(np.asarray(drain_ms)).percentiles()[
+        "p99_ms"
+    ]
+    if check:
+        assert drain_p99 < 0.1 * longest_gen_ms, (
+            f"p99 drain {drain_p99:.1f} ms is not < 10% of the "
+            f"{longest_gen_ms:.0f} ms the longest in-flight generation "
+            f"needed (drains: {[round(d, 1) for d in drain_ms]})"
+        )
+    return {
+        "failover": failover_half,
+        "drain": {
+            "rounds_ms": [round(d, 2) for d in drain_ms],
+            "p99_ms": round(drain_p99, 2),
+            "longest_gen_ms": longest_gen_ms,
+            "frac_of_longest_gen": round(drain_p99 / longest_gen_ms, 4),
+        },
+        "serve_drain_p99_ms": round(drain_p99, 2),
+        "failover_token_gap_ms": gap_hist.get("p50"),
+    }
+
+
+def measure_chaos() -> dict:
+    """The bench.py entry for the chaos tier: p99 drain latency of a
+    loaded replica (migration makes it ~transfer time) and the median
+    client-visible token gap across a mid-decode failover."""
+    out = run_chaos()
+    return {
+        "serve_drain_p99_ms": out["serve_drain_p99_ms"],
+        "failover_token_gap_ms": out["failover_token_gap_ms"],
+    }
+
+
 def kv_capacity_report(
     num_slots: int = 8,
     max_seq_len: int = MAX_SEQ_LEN,
@@ -1341,6 +1549,15 @@ def main(argv=None) -> int:
         "accepted-tokens/step >= 2 and a tokens/sec win)",
     )
     ap.add_argument(
+        "--chaos", action="store_true",
+        help="run the serving chaos acceptance: preempt one of three "
+        "replicas mid-decode (in-flight KV migrates to survivors — "
+        "zero re-prefill, generate() parity, failover token gap "
+        "measured) and time migration-based drains of a loaded "
+        "replica (p99 asserted < 10%% of the longest in-flight "
+        "generation)",
+    )
+    ap.add_argument(
         "--autoscale", action="store_true",
         help="run the autoscale-recovery acceptance: 2x-capacity "
         "overload on a 2-replica fleet -> FleetMonitor reports burn "
@@ -1380,6 +1597,8 @@ def main(argv=None) -> int:
         out["speculative"] = run_speculative()
     if args.overload:
         out["router_overload"] = run_router_overload()
+    if args.chaos:
+        out["chaos"] = run_chaos()
     if args.autoscale:
         out["fleet_scrape"] = measure_fleet_scrape()
         out["autoscale_recovery"] = run_autoscale_recovery()

@@ -24,6 +24,10 @@ if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
+echo "== chaos smoke (serving fault injection: migration, failover, drains)"
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest tests/ -q -m 'chaos and not slow' \
+    -p no:cacheprovider
+
 echo "== fast test tier (tier-1: not slow)"
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider

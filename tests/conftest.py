@@ -123,6 +123,29 @@ def pytest_terminal_summary(terminalreporter):
         )
 
 
+@pytest.fixture(autouse=True)
+def _chaos_env_guard(request):
+    """Chaos-marked tests drive env-gated fault injectors
+    (TPUDL_SERVE_CHAOS_*): snapshot and restore those knobs around each
+    one, so a failing chaos test cannot leak a kill/freeze knob into
+    every later engine constructed in this process."""
+    if "chaos" not in request.keywords:
+        yield
+        return
+    saved = {
+        k: v for k, v in os.environ.items()
+        if k.startswith("TPUDL_SERVE_CHAOS_")
+    }
+    try:
+        yield
+    finally:
+        for k in [
+            k for k in os.environ if k.startswith("TPUDL_SERVE_CHAOS_")
+        ]:
+            del os.environ[k]
+        os.environ.update(saved)
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from tpudl.runtime.mesh import MeshSpec, make_mesh

@@ -507,3 +507,79 @@ def test_fleet_monitor_lock_order_wrapped_and_clean(monkeypatch):
         roll = fleet.fleet_snapshot()
     assert roll["sources"]["self"]["ok"]
     assert monitor.violations == []
+
+
+def test_scrape_retry_absorbs_transient_hiccup():
+    """The retry satellite: a member that fails ONE attempt and
+    answers the in-band retry records a clean poll — no
+    fleet_scrape_failures_total bump, no aged member (before, a single
+    transient HTTP hiccup immediately failed the poll)."""
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("transient hiccup")
+        return {"registry": {"gauges": {"g": 1}}}
+
+    slept = []
+    fleet = FleetMonitor(
+        {"m": flaky}, scrape_interval_s=60.0, sleep=slept.append
+    )
+    fleet.scrape(force=True)
+    snap = fleet.fleet_snapshot()
+    assert snap["sources"]["m"]["ok"] is True
+    assert snap["sources"]["m"]["scrape_failures"] == 0
+    assert calls["n"] == 2  # first attempt + the one in-band retry
+    assert len(slept) == 1 and slept[0] > 0
+
+
+def test_scrape_retry_backoff_grows_with_jitter():
+    """A persistently-down member costs exactly one retry per poll
+    (failures count polls, not attempts), and the backoff before the
+    retry grows exponentially with the failure streak while staying
+    inside the jitter band (0.5x..1.5x of the capped base)."""
+
+    def dead():
+        raise ConnectionError("down")
+
+    slept = []
+    fleet = FleetMonitor(
+        {"m": dead}, scrape_interval_s=60.0,
+        retry_backoff_s=0.1, retry_backoff_max_s=10.0,
+        sleep=slept.append,
+    )
+    for poll in range(3):
+        fleet.scrape(force=True)
+    snap = fleet.fleet_snapshot()
+    assert snap["sources"]["m"]["scrape_failures"] == 3
+    assert len(slept) == 3
+    for i, delay in enumerate(slept):
+        base = 0.1 * (2 ** i)  # failure streak at retry time = i
+        assert 0.5 * base <= delay <= 1.5 * base, (i, delay)
+
+
+@pytest.mark.chaos
+def test_scrape_blackhole_chaos_consumes_retry_budget():
+    """tpudl.serve.chaos scrape blackhole: fail_n counts ATTEMPTS, so
+    fail_n=1 is absorbed by the retry (clean poll) while fail_n=3
+    fails the first poll outright and recovers on the next."""
+    from tpudl.serve import chaos
+
+    def snapshot():
+        return {"registry": {"gauges": {"g": 1}}}
+
+    slept = []
+    fleet = FleetMonitor(
+        {"m": snapshot}, scrape_interval_s=60.0, sleep=slept.append
+    )
+    fleet.scrape_fault = chaos.make_scrape_fault(fail_n=1)
+    fleet.scrape(force=True)
+    assert fleet.fleet_snapshot()["sources"]["m"]["scrape_failures"] == 0
+    fleet.scrape_fault = chaos.make_scrape_fault(fail_n=3)
+    fleet.scrape(force=True)  # attempts 1+2 blackholed -> failed poll
+    assert fleet.fleet_snapshot()["sources"]["m"]["scrape_failures"] == 1
+    fleet.scrape(force=True)  # attempt 3 blackholed, retry answers
+    snap = fleet.fleet_snapshot()
+    assert snap["sources"]["m"]["scrape_failures"] == 1
+    assert snap["sources"]["m"]["ok"] is True

@@ -156,6 +156,12 @@ _declare("TPUDL_SERVE_SPEC_K", "int", None,
          "Speculative-decoding window (draft proposes k tokens per "
          "verify dispatch); 0/unset = off.",
          "tpudl.serve.api")
+_declare("TPUDL_SERVE_MAX_FAILOVERS", "int", 3,
+         "Per-request failover-resubmission cap: a request ping-"
+         "ponging across successively dying replicas sheds as "
+         "failover_exhausted instead of looping forever (migrations "
+         "resume state and do not count).",
+         "tpudl.serve.router")
 
 # --- fault tolerance / chaos --------------------------------------------
 _declare("TPUDL_FT_GRACE_S", "float", 15.0,
@@ -185,6 +191,40 @@ _declare("TPUDL_CHAOS_IO_DELAY_S", "float", 0.0,
          "Fault injection: added per-write delay in the checkpoint "
          "writer (slow-disk simulation).",
          "tpudl.ft.chaos")
+_declare("TPUDL_SERVE_CHAOS_KILL_STEP", "int", None,
+         "Serving chaos: raise ChaosKill in Engine.step at decode "
+         "step N — the replica driver thread crashes (resubmit-"
+         "fallback path; KV unrecoverable).",
+         "tpudl.serve.chaos")
+_declare("TPUDL_SERVE_CHAOS_PREEMPT_STEP", "int", None,
+         "Serving chaos: raise ChaosPreempt at decode step N — the "
+         "replica turns lame duck (unready, thread answers) and its "
+         "seated KV must migrate to survivors.",
+         "tpudl.serve.chaos")
+_declare("TPUDL_SERVE_CHAOS_FREEZE_STEP", "int", None,
+         "Serving chaos: freeze Engine.step at decode step N for "
+         "TPUDL_SERVE_CHAOS_FREEZE_S seconds (stale-heartbeat path).",
+         "tpudl.serve.chaos")
+_declare("TPUDL_SERVE_CHAOS_FREEZE_S", "float", 1.0,
+         "Serving chaos: freeze duration for the step freezer.",
+         "tpudl.serve.chaos")
+_declare("TPUDL_SERVE_CHAOS_ONCE_DIR", "path", None,
+         "Serving chaos: marker directory making each injected fault "
+         "fire exactly once across every engine in the process (kill "
+         "ONE replica, not all).",
+         "tpudl.serve.chaos")
+_declare("TPUDL_SERVE_CHAOS_SCRAPE_FAIL_N", "int", 0,
+         "Serving chaos: blackhole the next N FleetMonitor scrape "
+         "attempts (install_scrape_chaos; retries consume the budget).",
+         "tpudl.serve.chaos")
+_declare("TPUDL_SERVE_CHAOS_SCRAPE_DELAY_S", "float", 0.0,
+         "Serving chaos: added delay per FleetMonitor scrape attempt.",
+         "tpudl.serve.chaos")
+_declare("TPUDL_SERVE_CHAOS_FLIP_MIGRATION", "flag", False,
+         "Serving chaos: flip one bit of every migration payload in "
+         "transfer — the crc must catch it and shed the request as "
+         "failed, never resume it.",
+         "tpudl.serve.chaos")
 
 # --- analysis ------------------------------------------------------------
 _declare("TPUDL_DEBUG_LOCK_ORDER", "flag", False,

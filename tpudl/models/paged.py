@@ -90,6 +90,21 @@ def quantize_kv(x: jax.Array):
     return q, scale
 
 
+def flat_page_row_index(page_table, page_size: int):
+    """Flat row index into a pool reshaped to ``[NP * page_size, ...]``:
+    logical position ``j`` of each table row maps to physical row
+    ``table[..., j // ps] * ps + j % ps``. Accepts ``[P]`` (one slot's
+    page ids — the radix gather and KV-migration paths) or ``[B, P]``
+    (the batched decode gather); the trailing axis flattens to
+    ``P * page_size`` either way. The ONE definition of page-table
+    address arithmetic shared by every pool gather."""
+    idx = (
+        page_table[..., :, None] * page_size
+        + jnp.arange(page_size, dtype=page_table.dtype)[None, :]
+    )
+    return idx.reshape(*page_table.shape[:-1], -1)
+
+
 def paged_write(
     pages: jax.Array,
     scales: Optional[jax.Array],
@@ -143,13 +158,7 @@ def paged_gather(
     gather for int8 pools. Unmapped logical pages resolve to the trash
     page — finite garbage the attention mask excludes."""
     np_, ps = pages.shape[0], view.page_size
-    bsz, p = view.page_table.shape
-    # flat physical index per (slot, logical position):
-    # page_table[b, j] * ps + offset.
-    flat_idx = (
-        view.page_table[:, :, None] * ps
-        + jnp.arange(ps, dtype=view.page_table.dtype)[None, None, :]
-    ).reshape(bsz, p * ps)
+    flat_idx = flat_page_row_index(view.page_table, ps)
     flat_pages = pages.reshape(np_ * ps, *pages.shape[2:])
     out = flat_pages[flat_idx]  # [B, L, Hkv, D]
     if view.quantized:

@@ -151,6 +151,9 @@ class FleetMonitor:
         scrape_interval_s: float = 0.5,
         scrape_timeout_s: float = 2.0,
         clock: Callable[[], float] = time.monotonic,
+        retry_backoff_s: float = 0.05,
+        retry_backoff_max_s: float = 1.0,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         if not sources:
             raise ValueError("FleetMonitor needs at least one source")
@@ -158,6 +161,18 @@ class FleetMonitor:
         self.scrape_interval_s = scrape_interval_s
         self.scrape_timeout_s = scrape_timeout_s
         self.clock = clock
+        #: One IN-BAND retry per member per poll, after an exponential
+        #: backoff (base doubling with the member's consecutive-failure
+        #: count, capped) with jitter — a single transient HTTP hiccup
+        #: no longer bumps ``fleet_scrape_failures_total`` and ages the
+        #: member; a genuinely down member costs one bounded extra wait.
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_backoff_max_s = retry_backoff_max_s
+        self._sleep = sleep
+        #: Chaos seam (tpudl.serve.chaos.install_scrape_chaos): called
+        #: with the member name before every scrape ATTEMPT; raising =
+        #: blackholed poll, sleeping = slow member.
+        self.scrape_fault: Optional[Callable[[str], None]] = None
         self._lock = threading.RLock()
         maybe_wrap_locks(self)
         self._state: Dict[str, dict] = {
@@ -191,7 +206,9 @@ class FleetMonitor:
 
     # -- scraping ------------------------------------------------------
 
-    def _scrape_one(self, source: Source) -> dict:
+    def _scrape_one(self, name: str, source: Source) -> dict:
+        if self.scrape_fault is not None:
+            self.scrape_fault(name)
         if callable(source):
             return dict(source())
         with urllib.request.urlopen(
@@ -199,23 +216,48 @@ class FleetMonitor:
         ) as resp:
             return json.loads(resp.read().decode())
 
+    def _retry_delay(self, failures: int) -> float:
+        """Backoff before the in-band retry: base doubling with the
+        member's consecutive-failure count (capped), jittered ±50% so N
+        monitors scraping a recovering fleet do not retry in lockstep
+        — the standard thundering-herd hedge."""
+        import random
+
+        base = min(
+            self.retry_backoff_s * (2 ** min(failures, 10)),
+            self.retry_backoff_max_s,
+        )
+        return base * (0.5 + random.random())
+
     def scrape(self, force: bool = True) -> None:
-        """Scrape every member (time-gated unless ``force``). A failed
-        member records the error and bumps its failure counter; its
-        last good snapshot is retained."""
+        """Scrape every member (time-gated unless ``force``), with ONE
+        in-band backoff+jitter retry per member before a poll counts as
+        failed — a transient hiccup costs a short sleep, not a failure
+        counter bump and an aged member. A member failed after the
+        retry records the error; its last good snapshot is retained."""
         now = self.clock()
         with self._lock:
             if not force and now - self._last_scrape < self.scrape_interval_s:
                 return
             self._last_scrape = now
             sources = dict(self.sources)
+            failure_counts = {
+                name: st["failures"] for name, st in self._state.items()
+            }
         for name, source in sources.items():
-            try:
-                snap = self._scrape_one(source)
-                err = None
-            except Exception as e:
-                snap = None
-                err = f"{type(e).__name__}: {e}"
+            snap = None
+            err = None
+            for attempt in (0, 1):
+                try:
+                    snap = self._scrape_one(name, source)
+                    err = None
+                    break
+                except Exception as e:
+                    err = f"{type(e).__name__}: {e}"
+                    if attempt == 0:
+                        self._sleep(
+                            self._retry_delay(failure_counts.get(name, 0))
+                        )
             with self._lock:
                 st = self._state.get(name)
                 if st is None:  # removed mid-scrape

@@ -17,6 +17,7 @@ drains the replica fleet off the router's measured signals
 (tpudl.serve.autoscale).
 """
 
+from tpudl.serve import chaos  # noqa: F401
 from tpudl.serve.api import (  # noqa: F401
     Request,
     Result,
@@ -29,6 +30,8 @@ from tpudl.serve.autoscale import (  # noqa: F401
     Autoscaler,
 )
 from tpudl.serve.cache import (  # noqa: F401
+    MigrationCompatError,
+    MigrationCorruptError,
     PagedKVCache,
     RadixPrefixTree,
     SlotCache,

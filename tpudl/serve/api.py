@@ -84,7 +84,12 @@ class Result:
     """Outcome of one request. ``tokens`` are the generated ids,
     INCLUDING the eos that ended generation (no padding — compare
     against a ``generate()`` row by prefix). finish_reason:
-    ``eos`` | ``length`` | ``shed_timeout`` | ``shed_capacity``."""
+    ``eos`` | ``length`` | ``shed_timeout`` | ``shed_capacity`` |
+    ``shed_slo`` | ``failover_exhausted`` (the router's per-request
+    failover-resubmission cap ran out — see
+    ``TPUDL_SERVE_MAX_FAILOVERS``) | ``failed: ...`` (a mid-prefill
+    exception, or a migration payload that could not be resumed —
+    corrupt transfers are shed here, never resumed silently)."""
 
     request_id: Any
     tokens: List[int]

@@ -280,15 +280,21 @@ class Autoscaler:
                 per_replica[n].get("busy", 0),
             ),
         )
+        t0 = self.clock()
         self.router.remove_replica(
             victim, drain=True, timeout_s=self.config.drain_timeout_s
         )
+        drain_ms = 1e3 * (self.clock() - t0)
         self.num_scale_downs += 1
         registry().counter("serve_autoscaler_scale_downs").inc()
         return {
             "action": "scale_down",
             "replica": victim,
             "reason": "idle",
+            # Migration-based drains make this ~transfer time, not
+            # O(longest in-flight generation) — the number that lets an
+            # operator read whether scale-downs are actually instant.
+            "drain_ms": round(drain_ms, 3),
             "at": now,
         }
 

@@ -29,7 +29,10 @@ batch-independent, so a refill is bit-invisible to the other slots
 
 from __future__ import annotations
 
+import json
+import struct
 import threading
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -1075,12 +1078,11 @@ class PagedKVCache:
         row_template = self._row_template
 
         def gather(pool_tree, page_ids, m_tok):
+            from tpudl.models.paged import flat_page_row_index
+
             def one(pool: dict, tmpl: dict) -> dict:
                 seq = int(tmpl["k"].shape[1])
-                flat_idx = (
-                    page_ids[:, None] * ps
-                    + jnp.arange(ps, dtype=page_ids.dtype)[None, :]
-                ).reshape(-1)
+                flat_idx = flat_page_row_index(page_ids, ps)
                 out = {}
                 for kv, name, sname in (
                     ("k", "pages_k", "scale_k"),
@@ -1142,6 +1144,219 @@ class PagedKVCache:
         if self.radix is not None:
             self._free.extend(self.radix.evict(self.radix.evictable_pages))
 
+    # -- page-granular migration ---------------------------------------
+
+    def export_request(self, slot: int, meta: dict, skip_tokens: int = 0) -> bytes:
+        """Serialize one seated request's KV state into a single
+        crc32-guarded payload: its logical rows ``[skip_tokens, lens)``
+        gathered straight out of the page pools in STORED dtype (int8
+        pages ship as int8 with their scale rows — import re-scatters
+        the exact bytes, so a quantized request resumes bit-identical),
+        plus the addressing facts (``lens``/``start``/alignment) the
+        target needs to rebuild its page-table row. ``meta`` is the
+        engine-owned request/sampling state riding along (tokens so
+        far, fold_in position, absolute deadline, reservation).
+
+        ``skip_tokens`` is the reference-first prefix contract: the
+        caller probed (and LEASED) that many tokens in the TARGET's
+        radix tree, so they ship as token-block references (the prompt
+        ids already in ``meta``) instead of page payload; a target
+        whose tree no longer holds them refuses the import
+        (``MigrationCompatError``) rather than resuming with holes.
+
+        Non-destructive: the caller frees the slot only once the
+        payload exists — the commit-or-invisible discipline of
+        tpudl.ft.store applied to a transfer."""
+        import numpy as np
+
+        if slot not in self._reserved and slot not in self._leases:
+            raise ValueError(f"slot {slot} is not seated")
+        lens = int(self.lens[slot])
+        start = int(self.start[slot])
+        left_aligned = start == 0
+        skip = int(skip_tokens)
+        if not 0 <= skip <= lens:
+            raise ValueError(f"skip_tokens {skip} outside [0, {lens}]")
+        if skip and not left_aligned:
+            raise ValueError(
+                "reference-prefix export requires a left-aligned slot "
+                "(pad-aligned rows cannot match the radix tree's "
+                "canonical token->position mapping)"
+            )
+        page_ids = jnp.asarray(self.page_table[slot], jnp.int32)
+        host = jax.device_get(_migration_gather(self.cache, page_ids))
+        flat, _ = jax.tree_util.tree_flatten_with_path(host)
+        leaves = [
+            (jax.tree_util.keystr(path), np.asarray(arr)[skip:lens])
+            for path, arr in flat
+        ]
+        payload_meta = dict(meta)
+        payload_meta.update(
+            kind="tpudl-kv-migration",
+            lens=lens,
+            start=start,
+            skip_tokens=skip,
+            left_aligned=left_aligned,
+            page_size=self.page_size,
+            quantized=self.quantized,
+        )
+        return pack_migration(payload_meta, leaves)
+
+    def import_request(self, payload, slot: int, lease=None) -> dict:
+        """Seat a migrated request's KV into ``slot`` from an
+        ``export_request`` payload: verify the crc, allocate the full
+        reservation, scatter the shipped rows into fresh pages, and
+        rebuild the page-table row — ZERO prefill compute. ``lease``
+        is a pre-pinned ``RadixPrefixTree.match_and_lease`` result
+        (the router pins the probed prefix BEFORE the transfer so
+        eviction cannot invalidate the reference contract mid-flight);
+        without one, a prefix-share cache matches here. The lease is
+        CONSUMED: released on every failure path, installed into the
+        slot's bookkeeping on success.
+
+        Raises ``MigrationCorruptError`` on a payload that fails
+        validation (never resume garbage) and ``MigrationCompatError``
+        on a structurally valid payload this cache cannot seat
+        (quantization/geometry mismatch, reference prefix the tree no
+        longer holds) — the caller's cue to fall back to a
+        from-scratch resubmission. Returns the payload's meta dict
+        (the engine rebuilds its slot state from it)."""
+        import numpy as np
+
+        meta = payload if isinstance(payload, dict) else parse_migration(payload)
+        matched_pages: list = []
+        deepest = None
+        if lease is not None:
+            matched_pages, deepest = lease
+        try:
+            if meta.get("kind") != "tpudl-kv-migration":
+                raise MigrationCorruptError(
+                    "payload is not a tpudl KV migration"
+                )
+            if bool(meta["quantized"]) != self.quantized:
+                raise MigrationCompatError(
+                    f"payload kv quantization ({meta['quantized']}) does "
+                    f"not match this cache ({self.quantized})"
+                )
+            if not 0 <= slot < self.num_slots:
+                raise IndexError(
+                    f"slot {slot} out of range [0, {self.num_slots})"
+                )
+            if slot in self._reserved or slot in self._leases:
+                raise ValueError(f"slot {slot} is already seated")
+            if lease is not None and self.radix is None:
+                raise ValueError(
+                    "import lease given but prefix_share is off"
+                )
+        except BaseException:
+            self.release_lease(deepest)
+            raise
+        lens = int(meta["lens"])
+        start = int(meta["start"])
+        skip = int(meta["skip_tokens"])
+        reserve = max(int(meta["reserve_tokens"]), lens)
+        ids = np.asarray(meta["request"]["input_ids"], np.int32)
+        if lease is not None and not meta["left_aligned"]:
+            # A pad-aligned payload's rows do not follow the radix
+            # tree's canonical token->position mapping: splicing the
+            # leased pages in would resume over WRONG KV. Drop the pin
+            # and import fully private (skip is 0 for these payloads —
+            # export refuses reference mode off a pad-aligned slot).
+            self.release_lease(deepest)
+            matched_pages, deepest = [], None
+        if lease is None and self.prefix_share and meta["left_aligned"]:
+            matched_pages, deepest = self.radix.match_and_lease(ids)
+        m = len(matched_pages)
+        try:
+            if reserve > self.max_seq_len:
+                raise MigrationCompatError(
+                    f"reserve_tokens {reserve} exceeds this cache's "
+                    f"per-slot bound {self.max_seq_len}"
+                )
+            if m * self.page_size < skip:
+                raise MigrationCompatError(
+                    f"payload ships rows only past token {skip} (prefix "
+                    f"by reference) but this cache's radix tree holds "
+                    f"{m * self.page_size} — re-export with the full "
+                    f"page payload"
+                )
+            rows = self._migration_rows(meta, lens, skip)
+            new_pages = self._alloc_pages(self.pages_needed(reserve) - m)
+        except BaseException:
+            self.release_lease(deepest)
+            raise
+        used = self.pages_needed(lens)
+        self.page_table[slot, :] = 0
+        self.page_table[slot, :m] = matched_pages
+        self.page_table[slot, m:m + len(new_pages)] = new_pages
+        self.start[slot] = start
+        self.lens[slot] = lens
+        # Matched pages (and reserved-but-unwritten ones past ``used``)
+        # aim at the trash page in the scatter's page_ids — their bytes
+        # are either already identical (matched) or garbage-until-
+        # written (reserve), exactly like seat_shared's skip contract.
+        page_ids = np.zeros((self.pages_per_slot,), np.int32)
+        page_ids[m:used] = self.page_table[slot, m:used]
+        self.cache = _migration_scatter(
+            self.cache, rows, jnp.asarray(page_ids)
+        )
+        tree_pages = 0
+        node = None
+        if self.radix is not None and meta["left_aligned"]:
+            # The prompt's full pages enter the tree so later requests
+            # share them — a migrated-in system prompt is as cacheable
+            # as a locally prefilled one.
+            full = int(ids.shape[0]) // self.page_size
+            if full > m:
+                node = self.radix.insert_suffix(
+                    deepest,
+                    self.radix.blocks_of(ids)[m:full],
+                    [int(p) for p in self.page_table[slot, m:full]],
+                )
+                tree_pages = full - m
+        final = node if node is not None else deepest
+        if final is not None:
+            self._leases[slot] = final
+        self._reserved[slot] = new_pages[tree_pages:]
+        return meta
+
+    def _migration_rows(self, meta: dict, lens: int, skip: int):
+        """Rebuild the full-span row pytree the scatter program takes
+        from a parsed payload's arrays, validating every leaf against
+        THIS cache's pool geometry (tail dims + stored dtype)."""
+        import numpy as np
+
+        span = self.pages_per_slot * self.page_size
+        arrays = meta["_arrays"]
+
+        def make_rows(pool: dict) -> dict:
+            return {
+                name: np.zeros((span,) + tuple(arr.shape[2:]), arr.dtype)
+                for name, arr in pool.items()
+            }
+
+        rows = _map_pools(self.cache, make_rows)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(rows)
+        filled = []
+        for path, buf in flat:
+            key = jax.tree_util.keystr(path)
+            src = arrays.get(key)
+            if src is None:
+                raise MigrationCompatError(
+                    f"payload has no rows for {key} — exported from a "
+                    f"different model geometry"
+                )
+            src = np.asarray(src)
+            want = (lens - skip,) + buf.shape[1:]
+            if tuple(src.shape) != want or src.dtype != buf.dtype:
+                raise MigrationCompatError(
+                    f"{key}: payload rows {tuple(src.shape)}/{src.dtype} "
+                    f"do not fit this cache's {want}/{buf.dtype}"
+                )
+            buf[skip:lens] = src
+            filled.append(buf)
+        return jax.tree_util.tree_unflatten(treedef, filled)
+
     # -- per-dispatch addressing ---------------------------------------
 
     def dispatch_args(self):
@@ -1185,3 +1400,180 @@ class PagedKVCache:
             self.page_table.nbytes + self.start.nbytes + self.lens.nbytes
         )
         return device + host
+
+
+# ---------------------------------------------------------------------------
+# Page-granular KV migration: the transfer format + pool gather/scatter
+# ---------------------------------------------------------------------------
+
+MIGRATION_MAGIC = b"TPUDLMIG"
+MIGRATION_VERSION = 1
+_MIGRATION_HEADER = struct.Struct("<II")  # (version, meta length)
+
+
+class MigrationCorruptError(RuntimeError):
+    """A migration payload failed validation (bad magic/version, crc32
+    mismatch, truncated array region): the bytes cannot be trusted and
+    the request must NOT be resumed from them — the transfer analog of
+    tpudl.ft.store's commit-or-invisible rule. The router sheds the
+    request as ``failed`` instead of decoding garbage."""
+
+
+class MigrationCompatError(ValueError):
+    """A structurally valid payload that cannot seat in THIS cache:
+    quantization or model-geometry mismatch, a reservation past the
+    per-slot bound, or a reference-only prefix the target's radix tree
+    no longer holds. Unlike corruption this is recoverable — the
+    router's fallback is the from-scratch resubmission path."""
+
+
+def pack_migration(meta: dict, leaves) -> bytes:
+    """One request's migration payload: ``MAGIC | version | meta-len |
+    meta json | raw leaf buffers | crc32``. ``leaves`` is an ordered
+    list of ``(path, ndarray)`` — descriptors (path/shape/dtype/offset)
+    land in the meta so parse needs no side channel. The trailing crc32
+    covers EVERYTHING before it, so any truncation or bit flip anywhere
+    in the transfer is caught before a single row is resumed."""
+    import numpy as np
+
+    descs = []
+    bufs = []
+    offset = 0
+    for path, arr in leaves:
+        arr = np.ascontiguousarray(arr)
+        descs.append({
+            "path": path,
+            "shape": list(arr.shape),
+            "dtype": arr.dtype.name,
+            "offset": offset,
+            "nbytes": int(arr.nbytes),
+        })
+        bufs.append(arr.tobytes())
+        offset += arr.nbytes
+    meta = dict(meta)
+    meta["arrays"] = descs
+    blob = json.dumps(meta).encode()
+    body = (
+        MIGRATION_MAGIC
+        + _MIGRATION_HEADER.pack(MIGRATION_VERSION, len(blob))
+        + blob
+        + b"".join(bufs)
+    )
+    return body + struct.pack("<I", zlib.crc32(body))
+
+
+def parse_migration(payload) -> dict:
+    """Decode + VERIFY a migration payload. Raises
+    ``MigrationCorruptError`` on anything that fails the magic /
+    version / crc32 / array-bounds checks — a corrupt transfer raises
+    here, at the door, never as a resumed-garbage token stream.
+    Returns the meta dict with ``"_arrays"`` holding the decoded
+    ``{path: ndarray}`` leaves."""
+    import numpy as np
+
+    head = len(MIGRATION_MAGIC) + _MIGRATION_HEADER.size
+    if not isinstance(payload, (bytes, bytearray, memoryview)):
+        raise TypeError(
+            f"migration payload must be bytes, got {type(payload).__name__}"
+        )
+    payload = bytes(payload)
+    if len(payload) < head + 4 or payload[: len(MIGRATION_MAGIC)] != (
+        MIGRATION_MAGIC
+    ):
+        raise MigrationCorruptError(
+            "not a tpudl migration payload (bad magic or truncated)"
+        )
+    (crc,) = struct.unpack("<I", payload[-4:])
+    if zlib.crc32(payload[:-4]) != crc:
+        raise MigrationCorruptError(
+            "crc32 mismatch — truncated or corrupted migration payload; "
+            "refusing to resume from it"
+        )
+    version, blob_len = _MIGRATION_HEADER.unpack(
+        payload[len(MIGRATION_MAGIC):head]
+    )
+    if version != MIGRATION_VERSION:
+        raise MigrationCorruptError(
+            f"migration payload version {version} != {MIGRATION_VERSION}"
+        )
+    try:
+        meta = json.loads(payload[head:head + blob_len].decode())
+    except Exception as e:
+        raise MigrationCorruptError(
+            f"unreadable migration meta: {type(e).__name__}: {e}"
+        ) from None
+    data = payload[head + blob_len:-4]
+    arrays = {}
+    for desc in meta.get("arrays", []):
+        end = desc["offset"] + desc["nbytes"]
+        if end > len(data):
+            raise MigrationCorruptError(
+                f"array region truncated: {desc['path']} ends at byte "
+                f"{end}, payload holds {len(data)}"
+            )
+        dtype = np.dtype(desc["dtype"])
+        arrays[desc["path"]] = np.frombuffer(
+            data,
+            dtype=dtype,
+            count=desc["nbytes"] // dtype.itemsize,
+            offset=desc["offset"],
+        ).reshape(desc["shape"])
+    meta["_arrays"] = arrays
+    return meta
+
+
+def _map_pools(tree, fn):
+    """Rebuild a PAGED cache pytree with every per-layer page-pool dict
+    replaced by ``fn(pool)`` — the migration analog of
+    ``_map_attn_caches`` (which matches dense k/v/valid/index dicts)."""
+    from collections.abc import Mapping
+
+    if isinstance(tree, Mapping) and "pages_k" in tree:
+        return fn(tree)
+    if isinstance(tree, Mapping):
+        return {k: _map_pools(v, fn) for k, v in tree.items()}
+    return tree
+
+
+@jax.jit
+def _migration_gather(cache, page_ids):
+    """Materialize one slot's logical rows from every pool leaf in
+    STORED dtype — no dequantization, so int8 pages and their scale
+    rows round-trip bit-exact through a migration. Module-level jit on
+    purpose: every cache with the same geometry (all replicas of a
+    fleet) shares ONE compiled program, so migrating never recompiles
+    per replica."""
+
+    from tpudl.models.paged import flat_page_row_index
+
+    def one(pool: dict) -> dict:
+        ps = pool["pages_k"].shape[1]
+        flat_idx = flat_page_row_index(page_ids, ps)
+        out = {}
+        for name, arr in pool.items():
+            flat = arr.reshape(arr.shape[0] * ps, *arr.shape[2:])
+            out[name] = flat[flat_idx]
+        return out
+
+    return _map_pools(cache, one)
+
+
+@jax.jit
+def _migration_scatter(cache, rows, page_ids):
+    """Write a full-span row pytree into the pools at ``page_ids``
+    (entries pinned to 0 land in the trash page — how matched-prefix
+    pages and the unwritten reserve tail are skipped without a second
+    program). The scatter twin of ``_migration_gather``, with the same
+    shared-compilation property."""
+
+    def one(pool: dict, r: dict) -> dict:
+        ps = pool["pages_k"].shape[1]
+        out = dict(pool)
+        for name, vals in r.items():
+            paged = vals.reshape(page_ids.shape[0], ps, *vals.shape[1:])
+            out[name] = out[name].at[page_ids].set(
+                paged.astype(out[name].dtype)
+            )
+        return out
+
+    return _zip_attn_caches(cache, rows, one)

@@ -69,7 +69,11 @@ import numpy as np
 from tpudl.obs import registry
 from tpudl.obs.spans import active_recorder
 from tpudl.serve.api import Request, Result
-from tpudl.serve.cache import SlotCache
+from tpudl.serve.cache import (
+    MigrationCompatError,
+    MigrationCorruptError,
+    SlotCache,
+)
 from tpudl.serve.queue import CAT_SERVE_REQUEST, AdmissionQueue, _Entry
 
 #: Span categories (their own rows in the obs report breakdown table).
@@ -146,7 +150,7 @@ class _Slot:
 
     __slots__ = (
         "entry", "request", "tokens", "position", "steps",
-        "t_seated", "t_first", "t_last",
+        "t_seated", "t_first", "t_last", "gap_origin",
     )
 
     def __init__(self, entry: _Entry, first_token: int, prompt_len: int,
@@ -159,6 +163,36 @@ class _Slot:
         self.t_seated = seated  # pop time: queue wait ends HERE
         self.t_first = now  # first token out: TTFT ends here (incl. prefill)
         self.t_last = now
+        # Migrated slots: the SOURCE's last-token time, consumed when
+        # the first post-migration token lands (the failover token-gap
+        # histogram — how long the client's stream actually stalled).
+        self.gap_origin: Optional[float] = None
+
+
+class _Migrated:
+    """One migrated-in request awaiting a free slot: the payload bytes
+    as transferred (crc verified lazily, ON the engine thread, so a
+    corrupt transfer becomes a ``failed`` Result instead of a router
+    crash) plus the radix lease the router pre-pinned on this cache."""
+
+    __slots__ = ("rid", "payload", "lease", "meta")
+
+    def __init__(self, rid: Any, payload, lease=None):
+        self.rid = rid
+        self.payload = payload
+        self.lease = lease
+        self.meta: Optional[dict] = None
+
+    def ensure_parsed(self) -> dict:
+        if self.meta is None:
+            from tpudl.serve.cache import parse_migration
+
+            self.meta = (
+                self.payload
+                if isinstance(self.payload, dict)
+                else parse_migration(self.payload)
+            )
+        return self.meta
 
 
 class Engine:
@@ -236,6 +270,18 @@ class Engine:
         import collections
 
         self.prefill_inbox = collections.deque()
+        # Migration inbox: (rid, payload, lease) triples appended by the
+        # router when a dying/draining replica's decode state is shipped
+        # here (_Migrated; drained by _fill_slots AHEAD of everything
+        # else — this work already paid its prefill somewhere).
+        self.migrate_inbox = collections.deque()
+        # Chaos injection (tpudl.serve.chaos, env-gated, default none):
+        # hooks called with the decode-step count at the top of step().
+        from tpudl.serve import chaos as serve_chaos
+
+        self.chaos_hooks: List[Callable[[int], None]] = (
+            serve_chaos.engine_step_hooks()
+        )
         # Stat counters (also mirrored into the obs registry): decode
         # steps are the deterministic cost unit the static-vs-continuous
         # comparison uses (wall time rides on them 1:1 at fixed slots).
@@ -280,7 +326,11 @@ class Engine:
             "healthy": True,
             "slots_busy": sum(s is not None for s in self._slots),
             "num_slots": self.num_slots,
-            "queue_depth": len(self.queue) + len(self.prefill_inbox),
+            "queue_depth": (
+                len(self.queue)
+                + len(self.prefill_inbox)
+                + len(self.migrate_inbox)
+            ),
             "queue_capacity": self.queue.capacity,
             "results_pending": len(self.results),
             "decode_steps": self.num_decode_steps,
@@ -510,6 +560,46 @@ class Engine:
                 self.cache.reset()
                 self.num_rollovers += 1
                 registry().counter("serve_rollovers").inc()
+        # Migrated-in requests seat FIRST: they are mid-stream — their
+        # prefill AND some decode are already paid, and every queued
+        # token of delay widens the client's visible stall (the
+        # failover token gap).
+        while self.migrate_inbox:
+            slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if slot is None:
+                break
+            item = self.migrate_inbox[0]
+            try:
+                meta = item.ensure_parsed()
+            except Exception as e:
+                # Corrupt transfer: caught by the crc at the door, shed
+                # as failed — NEVER resumed silently.
+                self.migrate_inbox.popleft()
+                self._fail_migrated(item.rid, e, lease=item.lease)
+                continue
+            if not self._fits_migrated(meta):
+                if self._fits_migrated_ever(meta):
+                    break  # fits once seated work frees pages
+                self.migrate_inbox.popleft()
+                self._fail_migrated(
+                    item.rid,
+                    RuntimeError(
+                        "migrated reservation cannot fit this cache "
+                        "even empty"
+                    ),
+                    lease=item.lease,
+                )
+                continue
+            self.migrate_inbox.popleft()
+            try:
+                self.install_migrated(meta, slot=slot, lease=item.lease)
+            except (MigrationCorruptError, MigrationCompatError,
+                    ValueError, RuntimeError) as e:
+                # install/import released the lease on their own
+                # failure paths — report only.
+                self._fail_migrated(item.rid, e)
         # Externally prefilled requests (disaggregation) seat first:
         # their prefill cost is already paid, a queue pop would re-pay
         # it locally.
@@ -612,6 +702,227 @@ class Engine:
             # the whole pool minus the trash page is reachable).
             return self.cache.pages_needed(need) <= self.cache.num_pages - 1
         return True
+
+    # -- page-granular migration ---------------------------------------
+
+    def export_request(self, rid: Any, skip_prefix_tokens: int = 0):
+        """Ship one SEATED request's full decode state — page-granular
+        KV (int8 as int8), generated tokens, per-request sampling
+        position (the ``fold_in(key(seed), t)`` index), and absolute
+        deadline — as a crc32-guarded payload another engine's
+        ``install_migrated`` resumes byte-exact, with zero prefill
+        dispatches. Returns ``None`` when the request is not seated
+        here, the cache is dense (migration is a paged-substrate
+        feature: pages are position-independent, dense rows are not),
+        or the engine speculates (the draft cache's state is not part
+        of the transfer contract yet) — the caller's cue to fall back
+        to a from-scratch resubmission.
+
+        ``skip_prefix_tokens`` omits that many leading logical rows
+        from the payload (the router probed AND LEASED them in the
+        target's radix tree — prefix by reference, not by bytes).
+        Commit-or-invisible: the slot is freed only after the payload
+        exists in full."""
+        if not self.paged or self.speculator is not None:
+            return None
+        slot = next(
+            (
+                i
+                for i, s in enumerate(self._slots)
+                if s is not None and s.request.request_id == rid
+            ),
+            None,
+        )
+        if slot is None:
+            return None
+        s = self._slots[slot]
+        req = s.request
+        # The payload meta is JSON: an id that does not round-trip
+        # (tuple -> list, custom object -> crash) would resume under a
+        # MUTATED identity — or an unhashable one that kills the
+        # target's loop. Decline instead; resubmission preserves the
+        # original object.
+        import json as _json
+
+        for value in (req.request_id, req.session_key):
+            try:
+                if _json.loads(_json.dumps(value)) != value:
+                    return None
+            except (TypeError, ValueError):
+                return None
+        skip = int(skip_prefix_tokens)
+        if skip and int(self.cache.start[slot]) != 0:
+            skip = 0  # pad-aligned rows cannot ship by tree reference
+        t0 = self.clock()
+        meta = {
+            "request": {
+                "request_id": req.request_id,
+                "input_ids": [int(t) for t in req.input_ids],
+                "max_new_tokens": req.max_new_tokens,
+                "eos_id": req.eos_id,
+                "temperature": req.temperature,
+                "seed": req.seed,
+                "priority": req.priority,
+                "deadline_s": req.deadline_s,
+                "session_key": req.session_key,
+            },
+            "tokens": [int(t) for t in s.tokens],
+            "position": s.position,
+            "steps": s.steps,
+            "prompt_ids_len": len(req.input_ids),
+            "submitted_at": s.entry.submitted_at,
+            "deadline_at": s.entry.deadline,
+            "t_seated": s.t_seated,
+            "t_first": s.t_first,
+            "t_last": s.t_last,
+            # What the target must reserve: rows written so far plus
+            # one page-write per token still to generate.
+            "reserve_tokens": int(self.cache.lens[slot])
+            + max(0, req.max_new_tokens - len(s.tokens)),
+        }
+        payload = self.cache.export_request(slot, meta, skip_tokens=skip)
+        # Commit point: the payload exists in full — the local copy of
+        # this request ends here (no double decode, no late Result).
+        self.cache.free(slot)
+        self._slots[slot] = None
+        reg = registry()
+        reg.counter("serve_migrations_exported").inc()
+        reg.counter("serve_migration_payload_bytes").inc(len(payload))
+        rec = active_recorder()
+        if rec is not None:
+            rec.event(
+                "migration_export", CAT_SERVE_REQUEST,
+                request_id=rid, payload_bytes=len(payload),
+                skip_tokens=skip, tokens_done=len(s.tokens),
+                export_s=self.clock() - t0,
+            )
+        return payload
+
+    def install_migrated(self, payload, slot: Optional[int] = None,
+                         lease=None) -> Any:
+        """Seat an ``export_request`` payload into a free slot and
+        resume decode at the recorded position: the KV rows scatter
+        straight into fresh pages, the sampling stream continues at the
+        recorded fold_in index, and NOT ONE prefill dispatch runs here.
+        The payload's absolute deadline is honored — a transfer that
+        exhausted the client's budget is recorded as ``shed_timeout``,
+        never resumed. Raises ``MigrationCorruptError`` on a payload
+        that fails the crc (resuming garbage is the one unforgivable
+        outcome) and ``MigrationCompatError`` on a cache this engine
+        cannot seat it in. Returns the request_id."""
+        from tpudl.serve.cache import parse_migration
+
+        try:
+            if not self.paged:
+                raise ValueError(
+                    "migration requires a paged cache (dense rows are "
+                    "not position-independent)"
+                )
+            if self.speculator is not None:
+                raise ValueError(
+                    "migration into a speculating engine is not "
+                    "supported (the draft cache is not part of the "
+                    "transfer contract)"
+                )
+            meta = (
+                payload
+                if isinstance(payload, dict) and "_arrays" in payload
+                else parse_migration(payload)
+            )
+            req = Request(**meta["request"])
+            entry = _Entry(
+                priority=req.priority, seq=0, request=req,
+                deadline=meta.get("deadline_at"),
+                submitted_at=meta["submitted_at"],
+            )
+        except BaseException:
+            if self.paged:
+                self.cache.release_lease(lease[1] if lease else None)
+            raise
+        if entry.deadline is not None and self.clock() > entry.deadline:
+            # The migration transfer ate the remaining budget: shed at
+            # the door (AdmissionQueue's never-start-past-deadline
+            # guarantee, kept across replica generations).
+            self.cache.release_lease(lease[1] if lease else None)
+            self._record_shed([entry], "shed_timeout")
+            return req.request_id
+        if slot is None:
+            slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+        if slot is None:
+            self.cache.release_lease(lease[1] if lease else None)
+            raise RuntimeError(
+                "no free slot for the migrated request (callers check "
+                "for one before installing)"
+            )
+        # Consumes the lease: released on every import failure path.
+        self.cache.import_request(meta, slot, lease=lease)
+        s = _Slot(
+            entry, int(meta["tokens"][0]), int(meta["prompt_ids_len"]),
+            float(meta["t_seated"]), float(meta["t_first"]),
+        )
+        s.tokens = [int(t) for t in meta["tokens"]]
+        s.position = int(meta["position"])
+        s.steps = int(meta["steps"])
+        s.t_last = float(meta["t_last"])
+        s.gap_origin = float(meta["t_last"])
+        self._slots[slot] = s
+        registry().counter("serve_migrations_installed").inc()
+        registry().gauge("serve_slots_busy").set(
+            sum(x is not None for x in self._slots)
+        )
+        rec = active_recorder()
+        if rec is not None:
+            rec.event(
+                "migration_install", CAT_SERVE_REQUEST,
+                request_id=req.request_id, slot=slot,
+                resumed_at_token=len(s.tokens),
+            )
+        return req.request_id
+
+    def _fail_migrated(self, rid: Any, exc: BaseException,
+                       lease=None) -> None:
+        """A migrated payload that cannot be resumed (corrupt transfer,
+        incompatible cache, unseatable reservation) surfaces as a
+        ``failed`` Result — the generation state is gone and silently
+        resuming garbage is forbidden, so honesty is all that's left."""
+        if lease is not None and self.paged:
+            self.cache.release_lease(lease[1])
+        self.results[rid] = Result(
+            request_id=rid, tokens=[],
+            finish_reason=f"failed: {type(exc).__name__}: {exc}",
+        )
+        reg = registry()
+        reg.counter("serve_requests_failed").inc()
+        reg.counter("serve_migrations_failed").inc()
+        rec = active_recorder()
+        if rec is not None:
+            rec.event(
+                "request_complete", CAT_SERVE_REQUEST, request_id=rid,
+                finish_reason="failed",
+                error=f"{type(exc).__name__}: {exc}", num_tokens=0,
+                shed_by="migration",
+            )
+
+    def _fits_migrated(self, meta: dict) -> bool:
+        """Can this payload's reservation seat RIGHT NOW? The radix
+        path credits the (pre-leased) matched prefix exactly like
+        ``fits_request`` does for fresh prompts."""
+        reserve = int(meta["reserve_tokens"])
+        if reserve > self.max_seq_len:
+            return False
+        if self.prefix_share and meta.get("left_aligned"):
+            return self.cache.fits_request(
+                meta["request"]["input_ids"], reserve
+            )
+        return self.cache.fits_tokens(reserve)
+
+    def _fits_migrated_ever(self, meta: dict) -> bool:
+        reserve = int(meta["reserve_tokens"])
+        if reserve > self.max_seq_len:
+            return False
+        return self.cache.pages_needed(reserve) <= self.cache.num_pages - 1
 
     # -- stepping ------------------------------------------------------
 
@@ -729,6 +1040,14 @@ class Engine:
                 continue
             s.position += 1
             s.steps += 1
+            if s.gap_origin is not None:
+                # First token after a migration landed: the client's
+                # stream stalled from the SOURCE's last token until now
+                # — the failover token gap the bench banks.
+                registry().histogram(
+                    "serve_failover_token_gap_ms"
+                ).observe(1e3 * (now - s.gap_origin))
+                s.gap_origin = None
             s.t_last = now
             tok = int(sel[i])
             s.tokens.append(tok)
@@ -864,6 +1183,12 @@ class Engine:
         """Seat what fits, run one decode step (speculative window when
         a speculator is attached). False when fully drained (no active
         slots and nothing seatable queued)."""
+        for hook in self.chaos_hooks:
+            # Fault injection (tpudl.serve.chaos): a kill hook raises
+            # (crashing the replica driver thread exactly like a real
+            # engine fault), a freeze hook sleeps here holding the
+            # whole loop (the stale-heartbeat path).
+            hook(self.num_decode_steps)
         self._fill_slots()
         if not self._active():
             # Nothing seated: the queue is empty or held only expired

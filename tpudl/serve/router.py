@@ -19,13 +19,20 @@ entirely from contracts earlier PRs shipped:
   by scraped ``(slots_busy + queue_depth) / (num_slots +
   queue_capacity)``. Unready replicas (scrape failed, 503, or
   ``healthy: false``) take no new work.
-- **Failover**: when a replica goes unready mid-stream, every request
-  assigned to it that has not produced a Result is resubmitted to the
-  surviving replicas (generation restarts — KV is not migrated; greedy
-  requests produce identical tokens, sampled ones reproduce via the
-  per-request fold_in stream). Late results from a failed replica are
-  ignored: the assignment map names the one replica a Result is
-  accepted from.
+- **Failover, migration-first**: when a replica goes unready
+  mid-stream, every request assigned to it that has not produced a
+  Result leaves it. If the replica's engine thread still answers (lame
+  duck, SLO 503, operator preemption), seated requests' page-granular
+  KV state is EXPORTED (crc-guarded payloads, tpudl.serve.cache) and
+  resumed mid-stream on survivors — zero re-prefill, byte-exact
+  continuation. A crashed thread means payloads are unavailable: the
+  request resubmits from scratch (greedy requests produce identical
+  tokens, sampled ones reproduce via the per-request fold_in stream),
+  capped per request by ``TPUDL_SERVE_MAX_FAILOVERS`` — a request
+  ping-ponging across successively dying replicas sheds as
+  ``failover_exhausted`` instead of looping forever. Late results from
+  a failed replica are ignored: the assignment map names the one
+  replica a Result is accepted from.
 - **Prefill/decode disaggregation**: with ``PrefillWorker``s attached,
   the router routes admitted requests through dedicated prefill
   replicas (batch-1 program only) which hand ``(row cache, first
@@ -64,8 +71,10 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from tpudl.analysis.concurrency import maybe_wrap_locks
+from tpudl.analysis.registry import env_int
 from tpudl.obs import registry
 from tpudl.obs.spans import active_recorder
+from tpudl.serve import chaos as serve_chaos
 from tpudl.serve.api import Request, Result, ServeSession, validate_request
 from tpudl.serve.queue import CAT_SERVE_REQUEST, _Entry
 
@@ -88,6 +97,7 @@ class Replica:
         health_fn: Optional[Callable[[], dict]] = None,
         idle_sleep_s: float = 0.0005,
         scrape_timeout_s: float = 1.0,
+        stale_after_s: Optional[float] = None,
     ):
         self.name = str(name)
         self.session = session
@@ -95,9 +105,25 @@ class Replica:
         self.health_fn = health_fn
         self.idle_sleep_s = idle_sleep_s
         self.scrape_timeout_s = scrape_timeout_s
+        #: In-process stale-heartbeat bound: a loop that has not
+        #: published for this long (frozen mid-step) scrapes UNREADY —
+        #: the in-process analog of the exporter's cadence-adaptive
+        #: /healthz staleness. None (default) disables; size it well
+        #: above one engine step.
+        self.stale_after_s = stale_after_s
         self._inbox: deque = deque()
         self._results: Dict[Any, Result] = {}
         self._results_lock = threading.Lock()
+        #: Router->replica-thread command queue (migration pulls): the
+        #: session is thread-exclusive, so KV exports run ON the loop
+        #: thread and the router waits on the command's event.
+        self._control: deque = deque()
+        self._published_at = time.monotonic()
+        #: Lame duck (chaos preemption notice / operator): scrapes
+        #: unready so the router stops placing and pulls our work, but
+        #: the thread stays alive to answer the migration command —
+        #: unlike ``failed``, which exits the loop (crash semantics).
+        self.lame = False
         maybe_wrap_locks(self)
         #: rid -> measured inbox wait (seconds), popped when the result
         #: is harvested: the router-door -> engine-admission hop of the
@@ -127,6 +153,114 @@ class Replica:
         straight onto the engine's disaggregation inbox."""
         self.session.engine.prefill_inbox.append(item)
 
+    def seat_migrated(self, rid, payload, lease=None) -> None:
+        """Queue a migrated-in request's payload onto the engine's
+        migration inbox. The crc is verified ON the engine thread, so
+        a corrupted transfer becomes that request's ``failed`` Result
+        instead of a router-thread crash."""
+        from tpudl.serve.engine import _Migrated
+
+        self.session.engine.migrate_inbox.append(
+            _Migrated(rid, payload, lease)
+        )
+
+    def request_migration(
+        self, skip_map: Dict[Any, int], timeout_s: float
+    ) -> Optional[dict]:
+        """Ask the replica THREAD to hand over every outstanding
+        request: seated slots exported as crc-guarded KV payloads
+        (``skip_map``: rid -> reference-prefix tokens the router
+        already leased on the chosen target), waiting work returned as
+        plain Requests. Returns None when the thread is gone or does
+        not answer within ``timeout_s`` — the crash half of the
+        contract: payload unavailable, the caller falls back to
+        resubmission."""
+        if self._thread is None or not self._thread.is_alive():
+            return None
+        if timeout_s <= 0:
+            return None  # no budget: don't enqueue work we won't read
+        box = {
+            "done": threading.Event(),
+            "lock": threading.Lock(),
+            "claimed": False,
+            "abandoned": False,
+            "skip": dict(skip_map),
+            "payloads": {},
+            "requests": {},
+        }
+        self._control.append(box)
+        if not box["done"].wait(timeout_s):
+            # The claim handshake makes abandonment safe: the loop
+            # CLAIMS the box (under its lock) before touching any
+            # state, so either we abandon an unclaimed box (the loop
+            # will skip it — frozen/dead thread, nothing was moved) or
+            # the export is actively running and we wait it out —
+            # exports free source slots, and an unread payload would
+            # be a silently lost request.
+            with box["lock"]:
+                if not box["claimed"]:
+                    box["abandoned"] = True
+                    return None
+            if not box["done"].wait(max(timeout_s, 5.0)):
+                return None  # export itself hung: give up loudly
+        return box
+
+    def _migrate_out(self, box: dict) -> None:
+        """Replica-thread half of a migration pull: everything
+        outstanding leaves this replica. Waiting work (inbox, admission
+        queue, disaggregation inbox) returns as Requests — nothing is
+        seated, nothing to export; seated slots export page-granular
+        payloads (skipping dense/speculating engines, which the caller
+        resubmits instead); already-queued migrate-inbox payloads
+        forward as-is, their local leases released."""
+        engine = self.session.engine
+        with box["lock"]:
+            if box.get("abandoned"):
+                return  # the router gave up waiting: touch nothing
+            box["claimed"] = True  # from here the router waits us out
+        while self._inbox:
+            request, _deadline_at, _enqueued_at = self._inbox.popleft()
+            box["requests"][request.request_id] = request
+        for entry in engine.queue.drain_all():
+            box["requests"][entry.request.request_id] = entry.request
+        while engine.prefill_inbox:
+            item = engine.prefill_inbox.popleft()
+            box["requests"][item.entry.request.request_id] = (
+                item.entry.request
+            )
+        while engine.migrate_inbox:
+            item = engine.migrate_inbox.popleft()
+            if item.lease is not None:
+                engine.cache.release_lease(item.lease[1])
+            try:
+                meta = item.ensure_parsed()
+            except Exception:
+                box["payloads"][item.rid] = item.payload
+                continue  # corrupt either way: the next engine sheds it
+            if int(meta.get("skip_tokens", 0)) > 0:
+                # A reference-skipped payload is whole ONLY against the
+                # tree it was probed on (whose lease we just released):
+                # forwarding it would make the next target refuse it.
+                # Hand back the Request instead — resubmission is the
+                # recoverable path.
+                box["requests"][item.rid] = Request(**meta["request"])
+            else:
+                box["payloads"][item.rid] = item.payload
+        for rid in [
+            s.request.request_id for s in engine._slots if s is not None
+        ]:
+            try:
+                payload = engine.export_request(
+                    rid, box["skip"].get(rid, 0)
+                )
+            except Exception:
+                payload = None  # caller resubmits from scratch
+            if payload is not None:
+                box["payloads"][rid] = payload
+        for rid in list(box["payloads"]) + list(box["requests"]):
+            self.session._pending_ids.discard(rid)
+            self._inbox_waits.pop(rid, None)
+
     def take(self) -> Dict[Any, Result]:
         """Hand over every Result harvested since the last take()."""
         with self._results_lock:
@@ -142,6 +276,29 @@ class Replica:
         both (test seam / custom probes)."""
         if self.failed:
             return {"healthy": False, "error": "replica failed"}
+        if self.lame:
+            # Preempted: out of service (no new placements, failover
+            # pulls our work) but the thread still answers exports.
+            return {
+                **self._published,
+                "healthy": False,
+                "error": "replica preempted (lame duck)",
+            }
+        if (
+            self.stale_after_s is not None
+            and self._thread is not None
+            and time.monotonic() - self._published_at > self.stale_after_s
+        ):
+            # Frozen mid-step: the loop stopped publishing. The last
+            # snapshot may claim healthy — staleness overrides it.
+            return {
+                **self._published,
+                "healthy": False,
+                "error": (
+                    f"stale heartbeat (no publish for "
+                    f"> {self.stale_after_s}s)"
+                ),
+            }
         if self.health_fn is not None:
             try:
                 return dict(self.health_fn())
@@ -220,6 +377,17 @@ class Replica:
         try:
             while not self._stop.is_set() and not self.failed:
                 worked = False
+                while self._control:
+                    # Migration pull: the router is waiting on the
+                    # command's event — answer before anything else
+                    # (and ALWAYS set it, or the router times out and
+                    # double-places the work it thinks we kept).
+                    box = self._control.popleft()
+                    try:
+                        self._migrate_out(box)
+                    finally:
+                        box["done"].set()
+                    worked = True
                 while self._inbox:
                     request, deadline_at, enqueued_at = self._inbox.popleft()
                     inbox_wait = max(0.0, time.monotonic() - enqueued_at)
@@ -302,7 +470,15 @@ class Replica:
                                 shed_by="replica_inbox",
                             )
                     worked = True
-                if engine.step():
+                try:
+                    if engine.step():
+                        worked = True
+                except serve_chaos.ChaosPreempt:
+                    # Injected preemption notice: leave service (the
+                    # next scrape reads unready and the router pulls
+                    # our seated KV) but keep the loop alive to answer
+                    # that pull — the drain-without-warning path.
+                    self.lame = True
                     worked = True
                 # Drain engine.results directly (NOT via _pending_ids):
                 # disaggregated requests arrive through the prefill
@@ -336,6 +512,7 @@ class Replica:
                         self._results.update(harvested)
                     worked = True
                 self._published = engine.health()
+                self._published_at = time.monotonic()
                 if not worked:
                     time.sleep(self.idle_sleep_s)
         except BaseException as e:
@@ -514,6 +691,9 @@ class Router:
         scrape_interval_s: float = 0.02,
         shed_priority_above: int = 0,
         clock: Callable[[], float] = time.monotonic,
+        migrate: bool = True,
+        migrate_timeout_s: float = 2.0,
+        max_failovers: Optional[int] = None,
     ):
         if not replicas:
             raise ValueError("Router needs at least one replica")
@@ -532,6 +712,22 @@ class Router:
         self.scrape_interval_s = scrape_interval_s
         self.shed_priority_above = shed_priority_above
         self.clock = clock
+        #: Migration-first recovery: on failover/drain, pull seated
+        #: requests' page-granular KV payloads from the leaving replica
+        #: (if its thread still answers within ``migrate_timeout_s``)
+        #: and resume them on survivors with zero re-prefill; False
+        #: restores the resubmit-only behavior.
+        self.migrate = bool(migrate)
+        self.migrate_timeout_s = migrate_timeout_s
+        #: Per-request cap on failover RESUBMISSIONS (from-scratch
+        #: restarts; migrations resume state and do not count): past
+        #: it the request sheds as ``failover_exhausted`` instead of
+        #: ping-ponging across dying replicas forever.
+        self.max_failovers = (
+            max_failovers
+            if max_failovers is not None
+            else env_int("TPUDL_SERVE_MAX_FAILOVERS", 3)
+        )
         self.results: Dict[Any, Result] = {}
         self._assigned: Dict[Any, Any] = {}  # rid -> (replica_name|None, Request)
         self._sticky: Dict[Any, str] = {}  # session_key -> replica name
@@ -572,6 +768,10 @@ class Router:
         self._last_scrape = float("-inf")
         self._seq = 0
         self.num_failovers = 0
+        self.num_migrations = 0
+        # rid -> failover-resubmission count (a routing book: mutated
+        # by _resubmit_failover and cleaned at every Result site).
+        self._failover_counts: Dict[Any, int] = {}
         for worker in self.prefill_workers:
             worker.place = self._place_prefilled
             worker.shed = self._shed_prefill_entry
@@ -695,44 +895,230 @@ class Router:
             self._failover(name)
 
     def _failover(self, name: str) -> None:
-        """Resubmit every outstanding request assigned to ``name``:
-        its results to date are harvested first (completed work is
-        kept), the rest restart on surviving replicas. Sticky keys
-        pinned to the dead replica are released."""
+        """Move every outstanding request off an unready replica,
+        MIGRATION-FIRST: completed results are harvested (kept), then
+        seated decode state is pulled as page-granular KV payloads and
+        resumed on survivors with zero re-prefill — if the replica's
+        engine thread still answers. A crashed thread (payload
+        unavailable) falls back to today's resubmission path, now
+        capped per request (``max_failovers``). Sticky keys pinned to
+        the replica are released either way."""
         with self._books:
             replica = next(
                 (r for r in self.replicas if r.name == name), None
             )
         if replica is None:  # removed concurrently: nothing to rescue
             return
+        self._relocate_outstanding(
+            replica, count_resubmits=True,
+            timeout_s=self.migrate_timeout_s,
+        )
+
+    def _pick_migration_target(
+        self,
+        exclude: str,
+        source_cache,
+        tentative: Dict[str, int],
+    ) -> Optional[Replica]:
+        """Least-loaded ready survivor whose cache can SEAT the
+        payload (paged, same KV quantization) — chosen BEFORE the
+        export so the reference-prefix probe pins pages on the replica
+        the payload will actually reach. ``tentative`` carries the
+        token load of payloads already directed at each survivor in
+        THIS relocation (the books only update at placement, so
+        without it every payload of a multi-slot failover would pick
+        the same replica)."""
+        quantized = bool(getattr(source_cache, "quantized", False))
+        with self._books:
+            ready = [
+                r for r in self.replicas
+                if r.name != exclude
+                and self._ready.get(r.name)
+                and r.name not in self._draining
+                and getattr(r.session.engine.cache, "paged", False)
+                and bool(
+                    getattr(r.session.engine.cache, "quantized", False)
+                ) == quantized
+            ]
+            if not ready:
+                return None
+            return min(
+                ready,
+                key=lambda r: (
+                    self._inflight[r.name] + tentative.get(r.name, 0),
+                    r.load,
+                ),
+            )
+
+    def _relocate_outstanding(
+        self, replica: Replica, count_resubmits: bool, timeout_s: float
+    ) -> None:
+        """The shared failover/drain mover: every outstanding request
+        leaves ``replica``. Seated decode state migrates (export ->
+        crc-guarded payload -> survivor's migrate inbox, resuming
+        mid-stream); waiting work and anything the replica could not
+        export (crashed/frozen thread, dense cache, speculating
+        engine) resubmits from scratch — counted against the
+        per-request failover cap when ``count_resubmits`` (unplanned
+        failover) and uncounted on planned drains. The caller already
+        took the replica out of placement (unready or draining)."""
+        name = replica.name
         self._harvest_one(replica)
         with self._books:
-            doomed = [
-                (rid, req)
+            doomed = {
+                rid: req
                 for rid, (owner, req) in self._assigned.items()
                 if owner == name
-            ]
+            }
             self._sticky = {
                 k: v for k, v in self._sticky.items() if v != name
             }
-            # Assignments are cleared BEFORE resubmission, so a late
-            # Result from the failed replica can't race the restarted
-            # one (harvest accepts a Result only from the current
-            # assignee).
-            for rid, req in doomed:
-                del self._assigned[rid]
-                self._inflight[name] -= req.max_new_tokens
+        if not doomed:
+            return
+        box = None
+        targets: Dict[Any, tuple] = {}
+        source_cache = getattr(replica.session.engine, "cache", None)
+        if self.migrate:
+            skip_map: Dict[Any, int] = {}
+            tentative: Dict[str, int] = {}
+            for rid, req in doomed.items():
+                target = self._pick_migration_target(
+                    name, source_cache, tentative
+                )
+                if target is None:
+                    continue  # no survivor: resubmission will shed
+                tentative[target.name] = (
+                    tentative.get(target.name, 0) + req.max_new_tokens
+                )
+                skip = 0
+                lease = None
+                cache = target.session.engine.cache
+                if getattr(cache, "prefix_share", False) and getattr(
+                    source_cache, "prefix_share", False
+                ):
+                    # Reference-first prefix contract: probe the
+                    # TARGET's radix tree and PRE-LEASE the match, so
+                    # those tokens ship as token-block references and
+                    # eviction cannot invalidate them mid-transfer
+                    # (tree ops are lock-guarded — safe cross-thread).
+                    # Source must ALSO share: only left-aligned slots
+                    # can ship a prefix by reference.
+                    if cache.prefix_match_len(req.input_ids) > 0:
+                        lease = cache.match_and_lease(req.input_ids)
+                        skip = len(lease[0]) * cache.page_size
+                targets[rid] = (target, lease)
+                skip_map[rid] = skip
+            if targets:
+                box = replica.request_migration(
+                    skip_map, timeout_s=timeout_s
+                )
         reg = registry()
-        for rid, req in doomed:
+        rec = active_recorder()
+        for rid, req in doomed.items():
+            payload = box["payloads"].get(rid) if box is not None else None
+            returned = box is not None and rid in box["requests"]
+            target, lease = targets.get(rid, (None, None))
+            target_ok = False
+            owned = False
+            if payload is not None and target is not None:
+                with self._books:
+                    # Ownership re-check INSIDE the mutation block: a
+                    # completion harvested between the doomed snapshot
+                    # and now already popped the assignment and
+                    # decremented the in-flight books — acting on the
+                    # stale entry would double-decrement and resurrect
+                    # a delivered request.
+                    cur = self._assigned.get(rid)
+                    owned = cur is not None and cur[0] == name
+                    target_ok = (
+                        owned
+                        and self._ready.get(target.name)
+                        and target.name not in self._draining
+                    )
+                    if target_ok:
+                        # Reassign BEFORE placing, so a late Result
+                        # from the leaving replica can't race the
+                        # resumed copy (harvest accepts a Result only
+                        # from the current assignee).
+                        self._assigned[rid] = (target.name, req)
+                        self._inflight[name] -= req.max_new_tokens
+                        self._inflight[target.name] += req.max_new_tokens
+                if target_ok:
+                    # Chaos seam: an env-gated bit flip here models a
+                    # corrupted transfer — the target's crc check MUST
+                    # shed it as failed, never resume it.
+                    payload = serve_chaos.maybe_corrupt_migration(payload)
+                    target.seat_migrated(rid, payload, lease=lease)
+                    self.num_migrations += 1
+                    reg.counter("serve_migrations_total").inc()
+                    if rec is not None:
+                        rec.event(
+                            "request_migrated", CAT_SERVE_REQUEST,
+                            request_id=rid, from_replica=name,
+                            to_replica=target.name,
+                            payload_bytes=len(payload),
+                        )
+                    continue
+            if lease is not None and target is not None:
+                # Pre-pinned reference prefix never shipped: unpin.
+                target.session.engine.cache.release_lease(lease[1])
+            if payload is not None and not owned:
+                continue  # completed concurrently: payload is moot
+            if (
+                not count_resubmits
+                and payload is None
+                and not returned
+            ):
+                # Planned drain and the request never left the replica
+                # (seated but unexportable — dense cache, speculating
+                # engine — or the command went unanswered): leave it
+                # assigned; the caller's wait loop delivers it in place
+                # rather than restarting mid-stream work.
+                continue
+            with self._books:
+                cur = self._assigned.get(rid)
+                if cur is None or cur[0] != name:
+                    continue  # resolved concurrently: nothing to move
+                self._assigned.pop(rid)
+                self._inflight[name] -= req.max_new_tokens
+            self._resubmit_failover(
+                rid, req, from_replica=name, count=count_resubmits
+            )
+
+    def _resubmit_failover(
+        self, rid, req: Request, from_replica: str, count: bool
+    ) -> None:
+        """The from-scratch fallback (KV unrecoverable): re-place the
+        request as if freshly submitted — the original deadline stamp
+        survives in ``_deadline_at``. ``count=True`` charges the
+        per-request failover budget: a request ping-ponging across
+        successively dying replicas sheds as ``failover_exhausted``
+        instead of re-paying prefill forever. ``count=False`` is the
+        planned-drain REQUEUE of waiting work — separate accounting,
+        because a drain is not a failover."""
+        rec = active_recorder()
+        if count:
+            with self._books:
+                n = self._failover_counts.get(rid, 0) + 1
+                self._failover_counts[rid] = n
+            if n > self.max_failovers:
+                self._shed(req, "failover_exhausted")
+                return
             self.num_failovers += 1
-            reg.counter("serve_router_requests_failed_over").inc()
-            rec = active_recorder()
+            registry().counter("serve_router_requests_failed_over").inc()
             if rec is not None:
                 rec.event(
                     "request_failover", CAT_SERVE_REQUEST,
-                    request_id=rid, from_replica=name,
+                    request_id=rid, from_replica=from_replica,
                 )
-            self.submit(req)
+        else:
+            registry().counter("serve_router_requests_requeued").inc()
+            if rec is not None:
+                rec.event(
+                    "request_requeued", CAT_SERVE_REQUEST,
+                    request_id=rid, from_replica=from_replica,
+                )
+        self.submit(req)
 
     def _harvest_one(self, replica: Replica) -> None:
         taken = replica.take()
@@ -745,6 +1131,7 @@ class Router:
                     _, req = self._assigned.pop(rid)
                     self._inflight[owner] -= req.max_new_tokens
                     self._deadline_at.pop(rid, None)
+                    self._failover_counts.pop(rid, None)
                     self.results[rid] = res
                 # else: a late result from a failed-over assignment —
                 # the restarted copy is authoritative; drop this one.
@@ -779,6 +1166,7 @@ class Router:
     ) -> None:
         with self._books:
             self._deadline_at.pop(request.request_id, None)
+            self._failover_counts.pop(request.request_id, None)
             self.results[request.request_id] = Result(
                 request_id=request.request_id, tokens=[],
                 finish_reason=reason, queue_wait_s=queue_wait_s,
@@ -1009,11 +1397,16 @@ class Router:
     ) -> Replica:
         """Shrink the fleet live. ``drain=True`` (the autoscaler's
         scale-down): the replica takes no new placements, its sticky
-        pins are released, and removal WAITS until every request
-        assigned to it has produced a Result — a drain never drops
-        in-flight work. ``drain=False`` stops it immediately and fails
-        its outstanding work over to the survivors (the replacement
-        path for a sick replica).
+        pins are released, and its in-flight decode state MIGRATES to
+        the surviving replicas (page-granular KV export, resumed
+        mid-stream — zero re-prefill), making drain latency
+        ~O(payload transfer) instead of O(longest generation); waiting
+        work resubmits. Work that cannot migrate (no survivors, dense
+        cache, speculating engine, a thread that stopped answering) is
+        WAITED out exactly as before — a drain never drops in-flight
+        work either way. ``drain=False`` stops the replica immediately
+        and fails its outstanding work over to the survivors (the
+        replacement path for a sick replica).
 
         On drain timeout the replica is returned to service (draining
         flag cleared) and TimeoutError raises — half-removed state is
@@ -1032,6 +1425,28 @@ class Router:
             None if timeout_s is None else self.clock() + timeout_s
         )
         if drain:
+            t_drain = self.clock()
+            with self._books:
+                survivors = any(
+                    r.name != name
+                    and self._ready.get(r.name)
+                    and r.name not in self._draining
+                    for r in self.replicas
+                )
+            if (
+                self.migrate
+                and survivors
+                and replica._thread is not None
+                and replica._thread.is_alive()
+            ):
+                # Migration drain: planned, so resubmissions of
+                # waiting work do NOT charge the failover cap.
+                budget = self.migrate_timeout_s
+                if timeout_s is not None:
+                    budget = min(budget, timeout_s)
+                self._relocate_outstanding(
+                    replica, count_resubmits=False, timeout_s=budget
+                )
             while True:
                 self._scrape()
                 self._harvest()
@@ -1050,6 +1465,9 @@ class Router:
                         f"requests still in flight after {timeout_s}s"
                     )
                 time.sleep(0.001)
+            registry().histogram("serve_drain_ms").observe(
+                1e3 * (self.clock() - t_drain)
+            )
         replica.stop()
         self._harvest_one(replica)
         if not drain:
