@@ -9,9 +9,14 @@ generalizes that into a first-class matrix over the serving decoder:
   ``int8`` weights (tpudl.quant), ``int8+kv8`` (int8 weights composed
   with the PR-8 paged int8 KV cache), ``fp8`` (e4m3 weights),
   ``prefix`` (f32 paged + radix prefix sharing — EXACT parity: COW
-  addressing must never change tokens), and ``spec`` (speculative
+  addressing must never change tokens), ``spec`` (speculative
   decoding, int8 self-draft — margin-mode parity: the chunked verify
-  program may flip genuine near-ties);
+  program may flip genuine near-ties), and ``lora``/``lora8``
+  (multi-tenant adapter serving, tpudl.serve.lora: a heterogeneous
+  batch gated PER ADAPTER against the sequential merged-into-base
+  reference — exact for f32 adapter pages, margin atol for int8
+  pages; both the Pallas segmented kernel in interpret mode and the
+  XLA composite fallback are gated);
 - **backend** columns: ``compiled`` (live jitted ServeSession) and
   ``exported`` (StableHLO artifacts through
   tpudl.export.decode.export_serving_decoder -> from_artifacts; paged
@@ -77,11 +82,27 @@ CELL_ATOL = {
     "fp8": 0.06,
     "prefix": None,
     "spec": 0.06,
+    # Multi-tenant adapter serving (tpudl.serve.lora): per-adapter
+    # parity vs the sequential one-adapter-at-a-time MERGED reference.
+    # ``lora`` (f32 adapter pages) is EXACT — segmented addressing
+    # must never change tokens; ``lora8`` (int8 pages) rides margin
+    # mode at a wider atol than the weight cells because the page
+    # quantization error is amplified by the adapter's alpha/rank
+    # scaling before it reaches the logits (the cell runs alpha=4).
+    "lora": None,
+    "lora8": 0.1,
 }
-PRECISIONS = ("f32", "bf16", "int8", "int8+kv8", "fp8", "prefix", "spec")
+PRECISIONS = (
+    "f32", "bf16", "int8", "int8+kv8", "fp8", "prefix", "spec",
+    "lora", "lora8",
+)
 BACKENDS = ("compiled", "exported")
 #: Speculation window for the ``spec`` row.
 SPEC_K = 3
+#: Tenant count / rank for the multi-tenant ``lora``/``lora8`` cells.
+LORA_TENANTS = 3
+LORA_RANK = 2
+LORA8_ALPHA = 4.0
 
 
 class CellUnrunnable(RuntimeError):
@@ -263,6 +284,133 @@ def build_cell_session(
     return ServeSession.from_artifacts(pre, dec, params_v)
 
 
+def _run_lora_cell(
+    precision: str,
+    backend: str,
+    ref_model,
+    ref_params,
+    num_slots: int,
+    n_parity: int,
+    n_latency: int,
+    latency_tokens: int,
+    sim_bw_gbps: float,
+    seed: int,
+) -> dict:
+    """The multi-tenant adapter cells: a heterogeneous batch (every
+    slot a different tenant, plus a tenantless base request) gated
+    per-adapter against the SEQUENTIAL one-adapter-at-a-time reference
+    (each tenant's factors merged into the base, run through plain
+    generate()). BOTH kernel paths are gated — the Pallas segmented
+    kernel (interpret mode on this CPU container) and the XLA
+    composite fallback — so the dispatch seam cannot hide a divergence
+    the production TPU path would serve. Latency is measured on the
+    composite session (interpret-mode Pallas pays a host overhead that
+    is an artifact of THIS container, not of the kernel)."""
+    import dataclasses as _dc
+
+    from benchmarks.serve_load import _with_sim_latency, make_adapters
+    from tpudl.export.latency import LatencyStats
+    from tpudl.quant import weight_bytes_report
+    from tpudl.serve import ServeSession
+    from tpudl.serve.lora import assert_tenant_parity
+
+    if backend != "compiled":
+        raise CellUnrunnable(
+            "adapter cells need the live segmented-LoRA programs; the "
+            "exported artifact contract does not carry adapter pools "
+            "yet — serve compiled-only"
+        )
+    int8 = precision == "lora8"
+    alpha = LORA8_ALPHA if int8 else 16.0
+    adapters = make_adapters(
+        LORA_TENANTS, rank=LORA_RANK, seed=seed + 11,
+        max_seq_len=MAX_SEQ_LEN,
+    )
+    atol = CELL_ATOL[precision]
+    cell = f"{precision}/{backend}"
+
+    def build(impl: str) -> "ServeSession":
+        return ServeSession.from_model(
+            ref_model, ref_params, prompt_len=PROMPT_LEN,
+            num_slots=num_slots, adapters=adapters,
+            adapter_dtype="int8" if int8 else None,
+            adapter_alpha=alpha, adapter_impl=impl,
+        )
+
+    def tenant_requests(n, tag, rq_seed, max_new=(4, 16)):
+        reqs = _make_requests(n, tag, seed=rq_seed, max_new=max_new)
+        cycle = [None] + list(adapters)
+        return [
+            _dc.replace(r, tenant=cycle[i % len(cycle)])
+            for i, r in enumerate(reqs)
+        ]
+
+    # -- parity gates: fused (interpret) AND composite vs the merged
+    # sequential reference, per adapter ------------------------------
+    fused = build("fused")
+    assert_tenant_parity(
+        fused, ref_model, ref_params, adapters,
+        tenant_requests(n_parity, cell + "-fused", seed),
+        atol=atol, alpha=alpha,
+    )
+    session = build("reference")
+    assert_tenant_parity(
+        session, ref_model, ref_params, adapters,
+        tenant_requests(n_parity, cell, seed),
+        atol=atol, alpha=alpha,
+    )
+
+    # -- bytes model + simulated-device latency ----------------------
+    pool = session.engine.adapter_pool
+    report = weight_bytes_report(ref_params)
+    kv_bytes = session.engine.cache.nbytes
+    # Per decode token: every weight byte + resident KV + the ACTIVE
+    # slots' adapter pages (the gather touches the seated tenants'
+    # rank units, not the whole pool).
+    active_adapter = min(
+        pool.nbytes, num_slots * LORA_RANK * pool.bytes_per_page
+    )
+    per_token = report["total_bytes"] + int(kv_bytes) + active_adapter
+    bytes_model = {
+        "weight_bytes": report["total_bytes"],
+        "kv_bytes": int(kv_bytes),
+        "adapter_bytes": int(pool.nbytes),
+        "bytes_per_token": per_token,
+        "quant_ratio": report["quant_ratio"],
+        "quantized_layer_bytes": report["quantized_layer_bytes"],
+        "quantized_layer_f32_bytes": report["quantized_layer_f32_bytes"],
+    }
+    sim_step_s = per_token / (sim_bw_gbps * 1e9)
+    session.engine.decode_call = _with_sim_latency(
+        session.engine.decode_call, sim_step_s
+    )
+    lat_reqs = tenant_requests(
+        n_latency, cell + "-lat", seed + 1,
+        max_new=(latency_tokens, latency_tokens + 1),
+    )
+    t0 = time.perf_counter()
+    results = session.serve(lat_reqs)
+    wall_s = time.perf_counter() - t0
+    tpots = [r.tpot_s for r in results.values() if r.tpot_s is not None]
+    assert tpots, f"cell {cell}: no TPOT samples"
+    tpot = LatencyStats.from_seconds(tpots)
+    tokens = sum(len(r.tokens) for r in results.values() if r.ok)
+    return {
+        "precision": precision,
+        "backend": backend,
+        "status": "pass",
+        "atol": atol,
+        **bytes_model,
+        "sim_step_ms": round(sim_step_s * 1e3, 4),
+        "tpot_ceiling_ms": round(
+            per_token / (HBM_GBPS * 1e9) * 1e3, 6
+        ),
+        "tpot_measured": tpot.percentiles(),
+        "tokens_per_sec": round(tokens / wall_s, 2),
+        "adapters_resident": pool.stats()["resident"],
+    }
+
+
 def run_cell(
     precision: str,
     backend: str,
@@ -284,6 +432,11 @@ def run_cell(
     from tpudl.export.latency import LatencyStats
     from tpudl.serve import assert_serving_parity
 
+    if precision.startswith("lora"):
+        return _run_lora_cell(
+            precision, backend, ref_model, ref_params, num_slots,
+            n_parity, n_latency, latency_tokens, sim_bw_gbps, seed,
+        )
     model_v, params_v, session_kwargs = _precision_variant(
         ref_model, ref_params, precision
     )

@@ -105,6 +105,16 @@ NOISE_BAND_FLOORS = {
     # count of silent regressions, not a timing draw, so it gates
     # zero-tolerance (see ZERO_TOLERANCE below).
     "serve_steady_state_recompiles": 0.01,
+    # Multi-tenant LoRA keys (benchmarks/serve_load.py --tenants,
+    # banked from r09). Adapters-per-GB is pool-layout arithmetic
+    # (deterministic like the KV capacity key); batched tokens/sec
+    # rides the sim device + host dispatch mix at 8 slots on 1 vCPU;
+    # the isolation ratio is a ratio of two p99 tails of
+    # scheduler-owned TTFTs, so its band stays wide (the in-benchmark
+    # 1.3x assertion is the real gate).
+    "serve_adapters_per_gb": 0.05,
+    "serve_tokens_per_sec_64adapters": 0.30,
+    "serve_tenant_isolation_p99_ratio": 0.50,
     # Serving fault-tolerance keys (benchmarks/serve_load.py --chaos,
     # banked from r08). Both ride command-pickup latency on the
     # replica loop thread: on 1 vCPU the scheduler owns their tail
@@ -130,6 +140,7 @@ LOWER_IS_BETTER = {
     "serve_steady_state_recompiles",
     "serve_drain_p99_ms",
     "failover_token_gap_ms",
+    "serve_tenant_isolation_p99_ratio",
 }
 
 #: Lower-is-better metrics whose banked baseline is 0 and must STAY 0:

@@ -24,6 +24,10 @@ if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
+echo "== multi-tenant smoke (adapter pool + segmented-LoRA batched decode)"
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmarks.serve_load \
+    --tenants --tenants-adapters 8 --requests 4 > /dev/null
+
 echo "== chaos smoke (serving fault injection: migration, failover, drains)"
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest tests/ -q -m 'chaos and not slow' \
     -p no:cacheprovider

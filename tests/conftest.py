@@ -45,7 +45,11 @@ import pytest  # noqa: E402
 _ENV_FAILURE_SIGNATURE = "Multiprocess computations aren't implemented"
 #: Non-slow tests known to hit the CPU-jaxlib multiprocess limitation at
 #: HEAD (the `slow`-marked spawn tests are deselected from tier-1 and
-#: tracked in CHANGES.md PR 4 instead).
+#: tracked in CHANGES.md PR 4 instead). These now carry
+#: @pytest.mark.needs_multiprocess and auto-skip above, so tier-1 runs
+#: fully green here — the nodeids stay pinned so a marker accidentally
+#: removed surfaces as a KNOWN failure, not a silently NEW one, while
+#: any OTHER test failing with the signature is still flagged loudly.
 _KNOWN_ENV_FAILURES = frozenset({
     "tests/test_graft_entry.py::test_dryrun_multichip_8",
 })
@@ -87,9 +91,17 @@ def pytest_collection_modifyitems(config, items):
         "hardware PRNG); the CPU tier runs the interpret-mode parity "
         "suite instead"
     )
+    skip_mp = pytest.mark.skip(
+        reason="requires a multi-process-capable backend: this CPU "
+        "jaxlib cannot compile cross-process computations "
+        "('Multiprocess computations aren't implemented on the CPU "
+        "backend'); the driver's TPU environment runs it"
+    )
     for item in items:
         if "tpu" in item.keywords:
             item.add_marker(skip)
+        if "needs_multiprocess" in item.keywords:
+            item.add_marker(skip_mp)
 
 
 @pytest.hookimpl(hookwrapper=True)

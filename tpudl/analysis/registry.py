@@ -156,6 +156,27 @@ _declare("TPUDL_SERVE_SPEC_K", "int", None,
          "Speculative-decoding window (draft proposes k tokens per "
          "verify dispatch); 0/unset = off.",
          "tpudl.serve.api")
+_declare("TPUDL_SERVE_LORA_RANK", "int", None,
+         "Multi-tenant adapter serving: per-tenant LoRA rank budget "
+         "(r_max, the adapter page-table width); unset = the largest "
+         "rank among the registered adapters.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_LORA_PAGES", "int", None,
+         "Multi-tenant adapter serving: adapter pool size in pages "
+         "(one page = one rank unit across every site; page 0 is the "
+         "all-zero page); unset = 64 full-rank adapters + 1.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_LORA_DTYPE", "str", None,
+         "Multi-tenant adapter serving: adapter page storage (int8 = "
+         "quantized pages with per-page f32 dequant scales); unset = "
+         "f32 pages.",
+         "tpudl.serve.api")
+_declare("TPUDL_SERVE_TENANT_QUOTA_TOKENS", "int", None,
+         "Router default per-tenant in-flight token quota (sum of "
+         "outstanding max_new_tokens); over it a tenant's requests "
+         "shed as shed_quota — the isolation lever; unset = "
+         "unlimited. Per-tenant overrides via Router(tenant_classes).",
+         "tpudl.serve.router")
 _declare("TPUDL_SERVE_MAX_FAILOVERS", "int", 3,
          "Per-request failover-resubmission cap: a request ping-"
          "ponging across successively dying replicas sheds as "

@@ -97,6 +97,76 @@ def paged_decode_fn(model, page_size: int, quantized: bool):
     return fn
 
 
+def lora_prefill_fn(model, impl: str = "auto"):
+    """THE multi-tenant prefill contract: ``(params, input_ids,
+    attention_mask, adapter_pools, adapter_table [1, r_max],
+    adapter_scale [1]) -> (last_logits, cache)``. The batch-1 prefill
+    with ONE tenant's adapter applied through the segmented-LoRA seam
+    (tpudl.models.lora.AdapterView) — an all-zero table row (every
+    entry on the never-written page 0) serves the plain base model, so
+    tenantless requests ride the same compiled program. ``impl`` is the
+    tpudl.ops dispatch seam for the segmented kernel (static)."""
+    from tpudl.models.lora import AdapterView
+
+    def fn(params, input_ids, attention_mask, apools, atable, ascale):
+        positions = jnp.maximum(
+            jnp.cumsum(attention_mask, axis=-1) - 1, 0
+        ).astype(jnp.int32)
+        logits, mutated = model.apply(
+            {"params": params},
+            input_ids,
+            attention_mask,
+            decode=True,
+            positions=positions,
+            adapters=AdapterView(
+                pools=apools, table=atable, scale=ascale, impl=impl
+            ),
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return fn
+
+
+def lora_paged_decode_fn(
+    model, page_size: int, quantized: bool, impl: str = "auto"
+):
+    """THE multi-tenant paged decode contract: ``paged_decode_fn``'s
+    seven arguments plus ``(adapter_pools, adapter_table [B, r_max],
+    adapter_scale [B])`` — every slot applies ITS tenant's adapter
+    pages through one segmented-LoRA dispatch per projection site
+    (tpudl.ops.segmented_lora). The pools and tables are traced
+    inputs, so loading/evicting adapters between steps never
+    recompiles; slots with no tenant carry an all-zero table row and
+    decode the plain base model."""
+    from tpudl.models.lora import AdapterView
+    from tpudl.models.paged import PagedView
+
+    def fn(
+        params, cache, token, position, page_table, start, lens,
+        apools, atable, ascale,
+    ):
+        view = PagedView(
+            page_table=page_table, start=start, lens=lens,
+            page_size=page_size, quantized=quantized,
+        )
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            token[:, None],
+            jnp.ones_like(token)[:, None],
+            decode=True,
+            positions=position[:, None],
+            paged=view,
+            adapters=AdapterView(
+                pools=apools, table=atable, scale=ascale, impl=impl
+            ),
+            mutable=["cache"],
+        )
+        return logits[:, -1, :], mutated["cache"]
+
+    return fn
+
+
 def chunk_prefill_fn(model):
     """THE suffix-prefill contract for prefix-sharing serving
     (tpudl.serve.cache radix mode): ``(params, cache, tokens [B, C],

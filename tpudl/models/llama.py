@@ -45,6 +45,13 @@ class LlamaConfig:
     remat: bool = False
     lora_rank: int = 0
     lora_alpha: float = 16.0
+
+    def __post_init__(self):
+        if self.lora_rank < 0:
+            raise ValueError(
+                f"lora_rank must be >= 0 (0 = adapters off), got "
+                f"{self.lora_rank}"
+            )
     # Fused-epilogue kernel tier (tpudl.ops.norms / mlp_fused): False
     # (default) = composite RMSNorm/SwiGLU, bit-identical to before the
     # tier; True = Pallas fused RMSNorm(+residual) and SwiGLU on TPU,
@@ -110,13 +117,13 @@ def _proj(cfg: LlamaConfig, features: int, name: str):
     are on (cfg.lora_rank > 0), or QuantDense when the low-precision
     weight seam is set (cfg.weight_dtype — serving only; the quantized
     sites are exactly the leaves tpudl.quant's LLAMA_QUANT_PATTERNS
-    match)."""
-    if cfg.weight_dtype is not None:
-        if cfg.lora_rank > 0:
-            raise ValueError(
-                "weight_dtype and lora_rank are mutually exclusive — "
-                "merge the adapters before quantizing for serving"
-            )
+    match). The two COMPOSE: weight_dtype + lora_rank > 0 runs a
+    LoRADense over a quantized base kernel (the base matmul dispatches
+    on what the tree holds, exactly like QuantDense) with the adapters
+    full precision on top — the QLoRA-style quantized-base fine-tune
+    shape. Adapter leaves fall under the quantizer's keep-all rule, so
+    quantize_model on a LoRA tree quantizes only the base kernels."""
+    if cfg.weight_dtype is not None and cfg.lora_rank == 0:
         from tpudl.quant.dense import QuantDense
 
         return QuantDense(
@@ -216,14 +223,23 @@ class LlamaAttention(nn.Module):
     @nn.compact
     def __call__(
         self, hidden, positions, kv_mask=None, decode: bool = False,
-        paged=None,
+        paged=None, adapters=None,
     ):
+        from tpudl.models.lora import adapter_delta
+
         cfg = self.cfg
         B, S, _ = hidden.shape
         hd = cfg.head_dim
+        # Multi-tenant adapters (tpudl.models.lora.AdapterView): each
+        # slot's per-tenant LoRA delta rides AFTER the shared base
+        # projection — one segmented-kernel dispatch per site, base
+        # weights (full-precision or quantized) resident exactly once.
         q = _proj(cfg, cfg.num_heads * hd, "q_proj")(hidden)
+        q = q + adapter_delta(adapters, "q_proj", hidden)
         k = _proj(cfg, cfg.num_kv_heads * hd, "k_proj")(hidden)
+        k = k + adapter_delta(adapters, "k_proj", hidden)
         v = _proj(cfg, cfg.num_kv_heads * hd, "v_proj")(hidden)
+        v = v + adapter_delta(adapters, "v_proj", hidden)
         q = q.reshape(B, S, cfg.num_heads, hd)
         k = k.reshape(B, S, cfg.num_kv_heads, hd)
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
@@ -272,7 +288,8 @@ class LlamaAttention(nn.Module):
                 q, kf, vf, paged_attend_mask(paged, chunk=S)
             )
             ctx = ctx.reshape(B, S, cfg.num_heads * hd)
-            return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+            out = _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+            return out + adapter_delta(adapters, "o_proj", ctx)
 
         if decode:
             # KV cache (flax decode idiom): static [B, max_seq, Hkv, D]
@@ -333,7 +350,8 @@ class LlamaAttention(nn.Module):
             # step that GQA exists to avoid).
             ctx = _gqa_decode_attention(q, k, v, mask)
             ctx = ctx.reshape(B, S, cfg.num_heads * hd)
-            return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+            out = _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+            return out + adapter_delta(adapters, "o_proj", ctx)
 
         if cfg.num_kv_heads != cfg.num_heads:  # GQA: expand kv heads
             reps = cfg.num_heads // cfg.num_kv_heads
@@ -351,7 +369,8 @@ class LlamaAttention(nn.Module):
             implementation=cfg.attention_impl,
         )
         ctx = ctx.reshape(B, S, cfg.num_heads * hd)
-        return _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+        out = _proj(cfg, cfg.hidden_size, "o_proj")(ctx)
+        return out + adapter_delta(adapters, "o_proj", ctx)
 
 
 class LlamaBlock(nn.Module):
@@ -360,8 +379,10 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(
         self, hidden, positions, kv_mask=None, decode: bool = False,
-        paged=None,
+        paged=None, adapters=None,
     ):
+        from tpudl.models.lora import adapter_delta
+
         cfg = self.cfg
         from tpudl.ops.norms import fused_ops_impl
 
@@ -372,6 +393,7 @@ class LlamaBlock(nn.Module):
             kv_mask,
             decode,
             paged,
+            adapters,
         )
         # The attention residual add rides inside the post-attention
         # norm kernel; the summed value comes back as the carried
@@ -396,10 +418,12 @@ class LlamaBlock(nn.Module):
             from tpudl.ops.mlp_fused import swiglu
 
             gate = _proj(cfg, cfg.intermediate_size, "gate_proj")(x)
+            gate = gate + adapter_delta(adapters, "gate_proj", x)
             up = _proj(cfg, cfg.intermediate_size, "up_proj")(x)
-            down = _proj(cfg, cfg.hidden_size, "down_proj")(
-                swiglu(gate, up, impl=impl)
-            )
+            up = up + adapter_delta(adapters, "up_proj", x)
+            act = swiglu(gate, up, impl=impl)
+            down = _proj(cfg, cfg.hidden_size, "down_proj")(act)
+            down = down + adapter_delta(adapters, "down_proj", act)
         hidden = hidden + down
         return constrain(hidden, ("dp", "fsdp"), "sp", "tp")
 
@@ -412,7 +436,7 @@ class LlamaModel(nn.Module):
     @nn.compact
     def __call__(
         self, input_ids, attention_mask=None, decode=False, positions=None,
-        paged=None,
+        paged=None, adapters=None,
     ):
         cfg = self.cfg
         # kv_mask=None keeps the unpadded fast path (no in-kernel validity
@@ -436,10 +460,15 @@ class LlamaModel(nn.Module):
         x = constrain(x, ("dp", "fsdp"), "sp", "tp")
         block = LlamaBlock
         if cfg.remat and not decode:
-            block = nn.remat(LlamaBlock, static_argnums=(4,))
+            # adapters never reach the remat path: multi-tenant views
+            # are decode-only (serving), and decode skips remat.
+            block = nn.remat(LlamaBlock, static_argnums=(4, 5))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(
-                x, positions, kv_mask, decode, paged
+                x, positions, kv_mask, decode, paged,
+                adapters.for_layer(f"layer_{i}")
+                if adapters is not None
+                else None,
             )
         from tpudl.ops.norms import fused_ops_impl
 
@@ -455,10 +484,10 @@ class LlamaForCausalLM(nn.Module):
     @nn.compact
     def __call__(
         self, input_ids, attention_mask=None, decode=False, positions=None,
-        paged=None,
+        paged=None, adapters=None,
     ):
         x = LlamaModel(self.cfg, name="model")(
-            input_ids, attention_mask, decode, positions, paged
+            input_ids, attention_mask, decode, positions, paged, adapters
         )
         logits = nn.Dense(
             self.cfg.vocab_size,

@@ -19,7 +19,8 @@ tree implements it — SURVEY.md §0). TPU-native design:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, Optional, Tuple
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -35,6 +36,15 @@ class LoRADense(nn.Module):
 
     Drop-in for nn.Dense (same param name "kernel"/"bias" for the base, so
     pretrained-weight import paths are unchanged; adapters are new leaves).
+
+    The base kernel may arrive QUANTIZED (a tpudl.quant
+    ``{"qvalues","qscale"}`` dict under the original "kernel" key — the
+    composed ``weight_dtype`` + ``lora_rank`` config): the base matmul
+    then runs the fused ``quant_dot`` contraction while the adapters
+    stay full precision on top — the QLoRA-style serving shape. Init
+    declares the same full-precision params either way, so param-tree
+    structure never depends on what the tree later holds (the
+    tpudl.quant.QuantDense dispatch-on-stored-value contract).
     """
 
     features: int
@@ -46,11 +56,25 @@ class LoRADense(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from tpudl.quant.dense import quant_dot
+        from tpudl.quant.quantize import is_quantized
+
         in_features = x.shape[-1]
-        kernel = self.param(
-            "kernel", self.kernel_init, (in_features, self.features)
+        stored = (
+            self.get_variable("params", "kernel")
+            if self.has_variable("params", "kernel")
+            else None
         )
-        y = jnp.dot(x, kernel.astype(self.dtype))
+        if is_quantized(stored):
+            # Quantized base: flax would shape-validate the dict against
+            # the initializer, so read it around self.param (the
+            # QuantDense idiom); dequant fuses into the contraction.
+            y = quant_dot(x, stored, compute_dtype=self.dtype)
+        else:
+            kernel = self.param(
+                "kernel", self.kernel_init, (in_features, self.features)
+            )
+            y = jnp.dot(x, kernel.astype(self.dtype))
         if self.rank > 0:
             lora_a = self.param(
                 "lora_a",
@@ -161,3 +185,150 @@ def merge_lora(params: Any, alpha_by_rank: Optional[float] = None) -> Any:
         return out
 
     return merge(params)
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant adapter serving (tpudl.serve.lora's model-side half).
+#
+# A single-tenant LoRADense bakes ONE adapter into the module; serving
+# thousands of tenants off one resident base model instead threads an
+# AdapterView through the decode path: per-slot page-table rows into
+# the tpudl.serve.lora.AdapterPool's rank-unit pools, applied AFTER
+# each base projection by tpudl.ops.segmented_lora (so the base may be
+# nn.Dense OR QuantDense — quantized base and per-tenant adapters
+# compose by construction).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AdapterView:
+    """Per-dispatch multi-tenant adapter addressing.
+
+    ``pools`` is the AdapterPool's pytree — ``{layer_name: {site:
+    {"a","b"[,"a_scale","b_scale"]}}}`` of traced pool arrays; ``table``
+    ([B, r_max] int32) maps each slot's logical rank units to physical
+    pages (0 = the never-written all-zero page, so empty slots and
+    short ranks contribute nothing); ``scale`` ([B] f32) is each slot's
+    alpha/rank. ``impl`` is the tpudl.ops dispatch seam for the
+    segmented kernel and is STATIC (baked into the compiled program);
+    the arrays are traced inputs, so loading/evicting adapters between
+    dispatches never recompiles."""
+
+    pools: Any
+    table: jax.Array
+    scale: jax.Array
+    impl: str = "auto"
+
+    def for_layer(self, name: str) -> Optional["AdapterView"]:
+        """The sub-view a single decoder block consumes (its sites
+        keyed "q_proj"/"gate_proj"/...); None when no tenant adapts
+        this layer."""
+        pools = self.pools.get(name)
+        if pools is None:
+            return None
+        return dataclasses.replace(self, pools=pools)
+
+
+def adapter_delta(view: Optional[AdapterView], site: str, x) -> Any:
+    """The multi-tenant LoRA delta for one projection site (0 when the
+    view or the site's pools are absent) — callers add it onto the base
+    projection output: ``y = proj(x) + adapter_delta(view, name, x)``."""
+    if view is None:
+        return 0
+    pools = view.pools.get(site)
+    if pools is None:
+        return 0
+    from tpudl.ops.segmented_lora import segmented_lora
+
+    return segmented_lora(
+        x, pools, view.table, view.scale, impl=view.impl
+    )
+
+
+def extract_adapters(params: Any) -> Dict[str, dict]:
+    """Flatten a LoRA param tree's adapters into ``{site_path:
+    {"lora_a": [in, r], "lora_b": [r, out]}}`` (site_path =
+    '/'-joined module path, e.g. ``model/layer_0/attention/q_proj``) —
+    the per-tenant unit tpudl.serve.lora.AdapterPool registers. The
+    base kernels are left behind: one resident base tree serves every
+    tenant."""
+    out: Dict[str, dict] = {}
+
+    def walk(node, prefix):
+        if not isinstance(node, dict):
+            return
+        if "lora_a" in node and "lora_b" in node:
+            out[prefix] = {
+                "lora_a": node["lora_a"], "lora_b": node["lora_b"]
+            }
+        for key, value in node.items():
+            walk(value, f"{prefix}/{key}" if prefix else key)
+
+    walk(params, "")
+    return out
+
+
+def as_flat_adapters(tree: Any) -> Dict[str, dict]:
+    """Normalize an adapter argument to the ``extract_adapters`` flat
+    form: an already-flat ``{site_path: {"lora_a", "lora_b"}}`` dict
+    passes through; anything else is treated as a full LoRA param tree
+    and extracted. THE one detection rule — AdapterPool.register, the
+    serving entry's rank probe, and the parity gate all normalize
+    through here, so the flat-form contract cannot drift between
+    doors."""
+    if tree and all(
+        isinstance(v, dict) and {"lora_a", "lora_b"} <= set(v)
+        for v in tree.values()
+    ):
+        return dict(tree)
+    return extract_adapters(tree)
+
+
+def strip_adapters(params: Any) -> Any:
+    """The base tree without adapter leaves (the resident-once half of
+    the split; ``extract_adapters`` is the per-tenant half)."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        return {
+            k: walk(v)
+            for k, v in node.items()
+            if k not in ("lora_a", "lora_b")
+        }
+
+    return walk(params)
+
+
+def merge_adapter(
+    base_params: Any, adapter: Dict[str, dict], alpha: float = 16.0
+) -> Any:
+    """Fold ONE tenant's extracted adapter into a copy of the base tree
+    (kernel += (alpha/r) A B at every adapted site) — the sequential
+    one-adapter-at-a-time reference the multi-tenant parity gate
+    compares against. Full-precision kernels only: parity references
+    are served unquantized."""
+    from tpudl.quant.quantize import is_quantized
+
+    merged = jax.tree.map(lambda x: x, base_params)
+    for path, factors in adapter.items():
+        node = merged
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node[part]
+        site = node[parts[-1]]
+        if "kernel" not in site:
+            raise ValueError(f"no kernel at adapter site {path!r}")
+        if is_quantized(site["kernel"]):
+            raise ValueError(
+                f"cannot merge an adapter into the quantized kernel at "
+                f"{path!r} — merge into the full-precision tree"
+            )
+        a = jnp.asarray(factors["lora_a"], jnp.float32)
+        b = jnp.asarray(factors["lora_b"], jnp.float32)
+        rank = a.shape[-1]
+        site["kernel"] = (
+            site["kernel"]
+            + ((a @ b) * (alpha / rank)).astype(site["kernel"].dtype)
+        )
+    return merged

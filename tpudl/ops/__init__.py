@@ -19,6 +19,9 @@ kernel tier, and expert-parallel MoE.
 - cross_entropy.py    — fused softmax-cross-entropy streaming the vocab
                         axis (online logsumexp; the [B, V] softmax is
                         never materialized);
+- segmented_lora.py   — heterogeneous-adapter batched LoRA delta over
+                        page pools (gather-from-pool in-kernel, f32
+                        accumulation; the multi-tenant serving matmul);
 - moe.py              — top-k routed expert FFN over `ep` (all-to-all).
 """
 
@@ -55,6 +58,10 @@ from tpudl.ops.mlp_fused import (  # noqa: F401
 from tpudl.ops.cross_entropy import (  # noqa: F401
     softmax_cross_entropy,
     softmax_cross_entropy_ref,
+)
+from tpudl.ops.segmented_lora import (  # noqa: F401
+    segmented_lora,
+    segmented_lora_ref,
 )
 from tpudl.ops.moe import (  # noqa: F401
     EP_MOE_RULES,

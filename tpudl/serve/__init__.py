@@ -12,9 +12,12 @@ synchronous Request/Result front end with token streaming that serves
 either a live model or a deserialized StableHLO artifact
 (tpudl.serve.api), a load-balancing router over N engine replicas
 with prefill/decode disaggregation and SLO-aware shedding
-(tpudl.serve.router), and the SLO-driven autoscaler that grows and
+(tpudl.serve.router), the SLO-driven autoscaler that grows and
 drains the replica fleet off the router's measured signals
-(tpudl.serve.autoscale).
+(tpudl.serve.autoscale), and multi-tenant LoRA serving — one resident
+base model with per-tenant adapters paged in and out like KV pages,
+decoded heterogeneously by the segmented-LoRA kernel
+(tpudl.serve.lora + tpudl.ops.segmented_lora).
 """
 
 from tpudl.serve import chaos  # noqa: F401
@@ -37,6 +40,10 @@ from tpudl.serve.cache import (  # noqa: F401
     SlotCache,
 )
 from tpudl.serve.engine import Engine  # noqa: F401
+from tpudl.serve.lora import (  # noqa: F401
+    AdapterPool,
+    assert_tenant_parity,
+)
 from tpudl.serve.queue import AdmissionQueue  # noqa: F401
 from tpudl.serve.speculate import Speculator  # noqa: F401
 from tpudl.serve.router import (  # noqa: F401

@@ -77,6 +77,12 @@ class Request:
     #: sharing a session_key land on the same replica (prefix/KV
     #: affinity). None = place purely by load.
     session_key: Optional[Any] = None
+    #: Multi-tenant adapter serving (tpudl.serve.lora): which tenant's
+    #: LoRA adapter decodes this request. None = the plain base model.
+    #: Flows through admission, placement (router adapter affinity +
+    #: per-tenant quotas/SLO classes), and migration payloads (failover
+    #: re-pins the adapter on the target replica).
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -192,6 +198,7 @@ class ServeSession:
         chunk_prefill_call: Optional[Callable] = None,
         speculator=None,
         verify_call: Optional[Callable] = None,
+        adapter_pool=None,
     ):
         # Deferred import: engine imports Request/Result from this
         # module.
@@ -215,6 +222,7 @@ class ServeSession:
             prompt_len, clock=clock, continuous=continuous,
             chunk_prefill_call=chunk_prefill_call,
             speculator=speculator, verify_call=verify_call,
+            adapter_pool=adapter_pool,
         )
         if slo is not None:
             # A tpudl.obs.slo.SloMonitor: the engine feeds it
@@ -249,6 +257,12 @@ class ServeSession:
         draft_weight_dtype: str = "int8",
         draft_model=None,
         draft_params=None,
+        adapters: Optional[Dict[str, Any]] = None,
+        adapter_rank_max: Optional[int] = None,
+        adapter_pages: Optional[int] = None,
+        adapter_dtype: Optional[str] = None,
+        adapter_alpha: float = 16.0,
+        adapter_impl: str = "auto",
         **kwargs,
     ) -> "ServeSession":
         """Live-model session: jit the prefill/decode contracts (batch 1
@@ -285,6 +299,26 @@ class ServeSession:
         ``num_pages`` (default: capacity parity with the dense cache)
         size the pool.
 
+        ``adapters={tenant: lora_tree}`` turns on MULTI-TENANT adapter
+        serving (tpudl.serve.lora): the base model stays resident once
+        while every tenant's LoRA A/B factors live in fixed-size paged
+        pools — loaded lazily, LRU-evicted at refcount 0 under
+        pressure, reloaded transparently — and each decode dispatch
+        applies every slot's own adapter through ONE segmented-matmul
+        dispatch per projection site (tpudl.ops.segmented_lora).
+        ``Request.tenant`` picks the adapter (None = plain base).
+        Requires ``paged`` (auto-enabled); composes with
+        ``weight_dtype`` — the old lora/quantization mutual exclusion
+        is lifted, since adapters ride OUTSIDE the base projections.
+        ``adapter_rank_max`` (``TPUDL_SERVE_LORA_RANK``; default = the
+        largest registered rank) bounds per-tenant rank,
+        ``adapter_pages`` (``TPUDL_SERVE_LORA_PAGES``) sizes the pool,
+        ``adapter_dtype="int8"`` (``TPUDL_SERVE_LORA_DTYPE``) stores
+        pages quantized with per-page dequant scales. Parity contract:
+        ``tpudl.serve.lora.assert_tenant_parity`` vs the sequential
+        merged-adapter reference — exact for f32 pages, teacher-forced
+        margin for int8.
+
         ``weight_dtype="int8"``/``"fp8_e4m3"`` (or
         ``TPUDL_SERVE_WEIGHT_DTYPE``) serves a QUANTIZED weight tree
         (tpudl.quant.quantize_model: attention/MLP projection kernels
@@ -297,6 +331,8 @@ class ServeSession:
         from tpudl.models.generate import (
             chunk_prefill_fn,
             decode_fn,
+            lora_paged_decode_fn,
+            lora_prefill_fn,
             paged_chunk_decode_fn,
             paged_decode_fn,
             prefill_fn,
@@ -323,6 +359,29 @@ class ServeSession:
             spec_k = env_int("TPUDL_SERVE_SPEC_K")
             if spec_k == 0:
                 spec_k = None
+        if adapters is not None:
+            if not adapters:
+                raise ValueError(
+                    "adapters={} registers no tenants — pass None to "
+                    "serve the plain base model"
+                )
+            # Adapter serving rides the paged substrate (same
+            # host-owned-table contract); a dense request for it is a
+            # config error, not a silent downgrade.
+            paged = True
+            if prefix_share:
+                raise ValueError(
+                    "prefix_share cannot compose with per-tenant "
+                    "adapters: k/v projections are tenant-adapted, so "
+                    "identical prompt tokens produce DIFFERENT KV per "
+                    "tenant — a shared page would be wrong for one of "
+                    "them"
+                )
+            if spec_k:
+                raise ValueError(
+                    "spec_k cannot compose with per-tenant adapters "
+                    "yet (the draft path has no adapter view)"
+                )
         pf = prefill_fn(model)
         ids = jax.ShapeDtypeStruct((num_slots, prompt_len), jnp.int32)
         _, cache_template = jax.eval_shape(pf, params, ids, ids)
@@ -348,6 +407,46 @@ class ServeSession:
             decode = jax.jit(
                 paged_decode_fn(model, cache.page_size, cache.quantized)
             )
+            if adapters is not None:
+                from tpudl.serve.lora import AdapterPool
+
+                if adapter_rank_max is None:
+                    adapter_rank_max = env_int("TPUDL_SERVE_LORA_RANK")
+                if adapter_pages is None:
+                    adapter_pages = env_int("TPUDL_SERVE_LORA_PAGES")
+                if adapter_dtype is None:
+                    adapter_dtype = env_str("TPUDL_SERVE_LORA_DTYPE")
+                if adapter_rank_max is None:
+                    # Default rank budget: the largest registered
+                    # adapter (probed off the trees before the pool
+                    # exists — ranks validate again at register).
+                    from tpudl.models.lora import as_flat_adapters
+
+                    ranks = [
+                        int(jnp.shape(f["lora_a"])[-1])
+                        for tree in adapters.values()
+                        for f in as_flat_adapters(tree).values()
+                    ]
+                    if not ranks:
+                        raise ValueError(
+                            "no lora_a/lora_b leaves in any adapter "
+                            "tree"
+                        )
+                    adapter_rank_max = max(ranks)
+                pool = AdapterPool(
+                    model.cfg,
+                    r_max=adapter_rank_max,
+                    num_slots=num_slots,
+                    num_pages=adapter_pages,
+                    dtype=adapter_dtype,
+                )
+                for tenant, tree in adapters.items():
+                    pool.register(tenant, tree, alpha=adapter_alpha)
+                kwargs["adapter_pool"] = pool
+                decode = jax.jit(lora_paged_decode_fn(
+                    model, cache.page_size, cache.quantized,
+                    impl=adapter_impl,
+                ))
             if prefix_share:
                 chunk_prefill = jax.jit(chunk_prefill_fn(model))
             if spec_k:
@@ -407,8 +506,13 @@ class ServeSession:
         else:
             cache = None
             decode = jax.jit(decode_fn(model))
+        prefill_call = (
+            jax.jit(lora_prefill_fn(model, impl=adapter_impl))
+            if adapters is not None
+            else jax.jit(pf)
+        )
         return cls(
-            jax.jit(pf), decode, params,
+            prefill_call, decode, params,
             cache_template, prompt_len, cache=cache,
             chunk_prefill_call=chunk_prefill, speculator=speculator,
             verify_call=verify, **kwargs,
@@ -528,6 +632,20 @@ class ServeSession:
         if rid in self._pending_ids or rid in self.engine.results:
             raise ValueError(f"duplicate request_id {rid!r}")
         validate_request(request, self.prompt_len, self.max_seq_len)
+        if request.tenant is not None:
+            pool = self.engine.adapter_pool
+            if pool is None:
+                raise ValueError(
+                    f"request {rid!r} names tenant {request.tenant!r} "
+                    f"but this session serves no adapters (build it "
+                    f"with ServeSession.from_model(adapters=...))"
+                )
+            if not pool.knows(request.tenant):
+                raise ValueError(
+                    f"unknown tenant {request.tenant!r} — register its "
+                    f"adapter before submitting (known: "
+                    f"{sorted(map(str, pool.tenants))})"
+                )
         self._pending_ids.add(rid)
         admitted = self.queue.push(
             request, priority=request.priority, deadline_s=request.deadline_s
@@ -699,53 +817,65 @@ def assert_serving_parity(
     stops); a wide margin means the cache returned wrong values and the
     assert fires. A real paging/dequant bug diverges immediately at
     wide margins, so the tolerance mode still catches it."""
-    from tpudl.models.generate import generate
-
     results = session.serve(list(requests))
     for req in requests:
         if req.temperature != 0.0:
             continue
         res = results[req.request_id]
         assert res.ok, (req.request_id, res.finish_reason)
-        want = np.asarray(
-            generate(
-                model, params,
-                jnp.asarray(req.input_ids, jnp.int32)[None, :],
-                max_new_tokens=req.max_new_tokens,
-                eos_id=req.eos_id,
-            )
-        )[0]
-        got = np.asarray(res.tokens)
-        if atol is None:
-            np.testing.assert_array_equal(
-                got, want[: got.shape[0]],
-                err_msg=f"request {req.request_id} diverged from "
-                        f"generate()",
-            )
-            if req.eos_id is not None and got.shape[0] < want.shape[0]:
-                assert np.all(want[got.shape[0]:] == req.eos_id), (
-                    f"request {req.request_id}: engine stopped at eos "
-                    f"but generate() kept producing non-eos tokens"
-                )
-            continue
-        n = min(got.shape[0], want.shape[0])
-        mismatches = np.nonzero(got[:n] != want[:n])[0]
-        if mismatches.size == 0:
-            continue
-        t = int(mismatches[0])
-        # Teacher-force the reference path up to the diverging step and
-        # measure how contested the reference's choice actually was.
-        prompt = np.asarray(req.input_ids, np.int32)
-        prefix = np.concatenate([prompt, want[:t].astype(np.int32)])
-        logits = model.apply(
-            {"params": params}, jnp.asarray(prefix)[None, :]
+        assert_tokens_match_generate(
+            model, params, req, np.asarray(res.tokens), atol
         )
-        last = np.asarray(logits[0, -1], np.float32)
-        margin = float(last[int(want[t])] - last[int(got[t])])
-        assert margin <= atol, (
-            f"request {req.request_id}: diverged from generate() at "
-            f"step {t} where the reference prefers token {want[t]} "
-            f"over the engine's {got[t]} by logit margin {margin:.4f} "
-            f"> atol={atol} — that is a cache bug, not a quantization "
-            f"near-tie"
+
+
+def assert_tokens_match_generate(model, params, req, got, atol) -> None:
+    """The per-request half of ``assert_serving_parity`` (factored so
+    the multi-tenant gate — tpudl.serve.lora.assert_tenant_parity,
+    whose REFERENCE params differ per request — reuses the exact same
+    rule): compare one greedy request's engine tokens against live
+    ``generate()`` on ``params``, exactly (``atol=None``) or under the
+    teacher-forced logit-margin contract."""
+    from tpudl.models.generate import generate
+
+    want = np.asarray(
+        generate(
+            model, params,
+            jnp.asarray(req.input_ids, jnp.int32)[None, :],
+            max_new_tokens=req.max_new_tokens,
+            eos_id=req.eos_id,
         )
+    )[0]
+    got = np.asarray(got)
+    if atol is None:
+        np.testing.assert_array_equal(
+            got, want[: got.shape[0]],
+            err_msg=f"request {req.request_id} diverged from "
+                    f"generate()",
+        )
+        if req.eos_id is not None and got.shape[0] < want.shape[0]:
+            assert np.all(want[got.shape[0]:] == req.eos_id), (
+                f"request {req.request_id}: engine stopped at eos "
+                f"but generate() kept producing non-eos tokens"
+            )
+        return
+    n = min(got.shape[0], want.shape[0])
+    mismatches = np.nonzero(got[:n] != want[:n])[0]
+    if mismatches.size == 0:
+        return
+    t = int(mismatches[0])
+    # Teacher-force the reference path up to the diverging step and
+    # measure how contested the reference's choice actually was.
+    prompt = np.asarray(req.input_ids, np.int32)
+    prefix = np.concatenate([prompt, want[:t].astype(np.int32)])
+    logits = model.apply(
+        {"params": params}, jnp.asarray(prefix)[None, :]
+    )
+    last = np.asarray(logits[0, -1], np.float32)
+    margin = float(last[int(want[t])] - last[int(got[t])])
+    assert margin <= atol, (
+        f"request {req.request_id}: diverged from generate() at "
+        f"step {t} where the reference prefers token {want[t]} "
+        f"over the engine's {got[t]} by logit margin {margin:.4f} "
+        f"> atol={atol} — that is a cache bug, not a quantization "
+        f"near-tie"
+    )

@@ -215,6 +215,7 @@ class Engine:
         chunk_prefill_call: Optional[Callable] = None,
         speculator=None,
         verify_call: Optional[Callable] = None,
+        adapter_pool=None,
     ):
         if prompt_len < 1 or prompt_len >= cache.max_seq_len:
             raise ValueError(
@@ -242,6 +243,31 @@ class Engine:
             getattr(cache, "prefix_share", False)
         )
         self.chunk_prefill_call = chunk_prefill_call
+        # Multi-tenant LoRA serving (tpudl.serve.lora.AdapterPool):
+        # when present, the prefill/decode programs are the lora_*
+        # contracts (three extra traced inputs — pools, per-slot page
+        # table, per-slot scaling) and each seated request pins its
+        # tenant's adapter pages for the slot's lifetime.
+        self.adapter_pool = adapter_pool
+        if adapter_pool is not None:
+            if not self.paged:
+                raise ValueError(
+                    "multi-tenant adapters require a paged cache (the "
+                    "adapter pool rides the same host-owned-table "
+                    "contract)"
+                )
+            if self.prefix_share:
+                raise ValueError(
+                    "adapter serving cannot share KV prefixes across "
+                    "tenants (k/v projections are tenant-adapted, so "
+                    "identical tokens produce DIFFERENT pages per "
+                    "tenant) — prefix_share must be off"
+                )
+            if speculator is not None:
+                raise ValueError(
+                    "speculative decoding with per-tenant adapters is "
+                    "not supported (the draft has no adapter view)"
+                )
         # Speculative decoding (tpudl.serve.speculate): draft k cheap
         # tokens, verify them in ONE slot-batched chunk dispatch.
         self.speculator = speculator
@@ -347,6 +373,8 @@ class Engine:
                 out["prefix_cache"] = self.cache.radix.stats()
             if self.speculator is not None:
                 out["spec_k"] = self.speculator.k
+            if self.adapter_pool is not None:
+                out["adapters"] = self.adapter_pool.stats()
         else:
             out["write_index"] = self.cache.write_index
         return out
@@ -420,8 +448,16 @@ class Engine:
         t0 = self.clock()
         lease = None
         hit = 0
+        tenant_pinned = False
         row_offset = self.prompt_len - int(ids.shape[0])
         try:
+            if self.adapter_pool is not None:
+                # Pin the tenant's adapter pages BEFORE the prefill
+                # dispatch (loading them on demand — an evicted
+                # tenant's next request reloads transparently here);
+                # the pin transfers to the slot at bind time.
+                arow, ascale = self.adapter_pool.acquire(req.tenant)
+                tenant_pinned = req.tenant is not None
             if self.prefix_share:
                 lease = self.cache.match_and_lease(ids)
                 # A fully-matched prompt still needs its LAST token's
@@ -449,13 +485,23 @@ class Engine:
                     [np.zeros(pad, np.int32),
                      np.ones(ids.shape[0], np.int32)]
                 )[None, :]
-                logits, row_cache = self.prefill_call(
-                    self.params, padded, mask
-                )
+                if self.adapter_pool is not None:
+                    logits, row_cache = self.prefill_call(
+                        self.params, padded, mask,
+                        self.adapter_pool.pools,
+                        arow[None, :],
+                        np.float32([ascale]),
+                    )
+                else:
+                    logits, row_cache = self.prefill_call(
+                        self.params, padded, mask
+                    )
             first = first_token(logits, req)
         except BaseException:
             if lease is not None:
                 self.cache.release_lease(lease[1])
+            if tenant_pinned:
+                self.adapter_pool.release(req.tenant)
             raise
         now = self.clock()
         if rec is not None:
@@ -472,7 +518,8 @@ class Engine:
         self.num_prefills += 1
         registry().counter("serve_prefills").inc()
         self._install(entry, slot, row_cache, first, ids.shape[0], t0, now,
-                      lease=lease, row_offset=row_offset)
+                      lease=lease, row_offset=row_offset,
+                      tenant_pinned=self.adapter_pool is not None)
 
     def _seat_prefilled(self, item: _Prefilled, slot: int) -> None:
         """Seat a request a DEDICATED prefill replica already prefilled
@@ -486,32 +533,55 @@ class Engine:
     def _install(self, entry: _Entry, slot: int, row_cache: Any,
                  first: int, ids_len: int, t_popped: float,
                  t_first: float, lease=None, row_offset: Optional[int] = None,
+                 tenant_pinned: bool = False,
                  ) -> None:
         """Shared seat tail: cache insertion (dense scatter, paged
         reservation+scatter, or radix-shared left-aligned seat),
-        latency accounting, draft-cache seating, slot activation."""
+        latency accounting, draft-cache seating, adapter binding, slot
+        activation."""
         req = entry.request
-        if self.prefix_share:
-            ids = np.asarray(req.input_ids, np.int32)
-            if lease is None:
-                # Disaggregated handoff: the worker prefilled the full
-                # row; matched pages still dedup (values identical).
-                lease = self.cache.match_and_lease(ids)
-            self.cache.seat_shared(
-                row_cache, slot, ids, ids_len + req.max_new_tokens,
-                lease=lease,
-                row_offset=(
-                    self.prompt_len - ids_len
-                    if row_offset is None else row_offset
-                ),
-            )
-        elif self.paged:
-            self.cache.seat(
-                row_cache, slot, self.prompt_len - ids_len,
-                self.prompt_len, self.prompt_len + req.max_new_tokens,
-            )
-        else:
-            self.cache.insert(row_cache, slot)
+        tenant = getattr(req, "tenant", None)
+        if self.adapter_pool is not None and not tenant_pinned:
+            # Externally prefilled path (no _seat ran): pin here. The
+            # router rejects tenant-ful requests on the disaggregated
+            # path, so this only ever pins None (a no-op) — kept
+            # anyway so the invariant "a bound slot holds a pin" has
+            # one owner.
+            self.adapter_pool.acquire(tenant)
+        try:
+            if self.prefix_share:
+                ids = np.asarray(req.input_ids, np.int32)
+                if lease is None:
+                    # Disaggregated handoff: the worker prefilled the
+                    # full row; matched pages still dedup (values
+                    # identical).
+                    lease = self.cache.match_and_lease(ids)
+                self.cache.seat_shared(
+                    row_cache, slot, ids, ids_len + req.max_new_tokens,
+                    lease=lease,
+                    row_offset=(
+                        self.prompt_len - ids_len
+                        if row_offset is None else row_offset
+                    ),
+                )
+            elif self.paged:
+                self.cache.seat(
+                    row_cache, slot, self.prompt_len - ids_len,
+                    self.prompt_len, self.prompt_len + req.max_new_tokens,
+                )
+            else:
+                self.cache.insert(row_cache, slot)
+        except BaseException:
+            # A failed seat must not strand the tenant pin: the slot
+            # was never bound, so free_slot will never run for it —
+            # without this release the pages would be unevictable for
+            # the process lifetime.
+            if self.adapter_pool is not None:
+                self.adapter_pool.release(tenant)
+            raise
+        if self.adapter_pool is not None:
+            # The seat pin transfers to the slot; free_slot drops it.
+            self.adapter_pool.bind_slot(slot, tenant)
         if self.speculator is not None:
             self.speculator.seat(
                 slot, np.asarray(req.input_ids, np.int32),
@@ -655,7 +725,14 @@ class Engine:
         pages seat for free — sharing multiplies admission capacity on
         top of int8's byte multiplier), and left-aligned seating
         reserves from the real prompt length, not the padded window.
-        A speculating engine additionally needs draft-cache room."""
+        A speculating engine additionally needs draft-cache room; an
+        adapter-serving engine needs the tenant's pages securable
+        (resident, or loadable by evicting lease-free adapters)."""
+        if self.adapter_pool is not None and (
+            getattr(request, "tenant", None) is not None
+        ):
+            if not self.adapter_pool.can_seat(request.tenant):
+                return False
         if self.speculator is not None:
             # Pad-aligned draft seating reserves the full prompt
             # window. submit() already validates prompt_len + max_new
@@ -689,6 +766,11 @@ class Engine:
         )
         if need > self.max_seq_len:
             return False
+        if self.adapter_pool is not None and (
+            getattr(request, "tenant", None) is not None
+        ):
+            if not self.adapter_pool.can_ever_seat(request.tenant):
+                return False
         if self.speculator is not None:
             draft_need = self.prompt_len + request.max_new_tokens
             if draft_need > self.speculator.cache.max_seq_len or (
@@ -737,14 +819,15 @@ class Engine:
             return None
         s = self._slots[slot]
         req = s.request
-        # The payload meta is JSON: an id that does not round-trip
+        # The payload meta is JSON: an id (or tenant key — it feeds a
+        # dict lookup on the target) that does not round-trip
         # (tuple -> list, custom object -> crash) would resume under a
         # MUTATED identity — or an unhashable one that kills the
         # target's loop. Decline instead; resubmission preserves the
         # original object.
         import json as _json
 
-        for value in (req.request_id, req.session_key):
+        for value in (req.request_id, req.session_key, req.tenant):
             try:
                 if _json.loads(_json.dumps(value)) != value:
                     return None
@@ -765,6 +848,10 @@ class Engine:
                 "priority": req.priority,
                 "deadline_s": req.deadline_s,
                 "session_key": req.session_key,
+                # The tenant id rides the payload so failover RE-PINS
+                # the adapter on the target engine's pool (reloading it
+                # there if needed) before decode resumes.
+                "tenant": req.tenant,
             },
             "tokens": [int(t) for t in s.tokens],
             "position": s.position,
@@ -784,6 +871,8 @@ class Engine:
         # Commit point: the payload exists in full — the local copy of
         # this request ends here (no double decode, no late Result).
         self.cache.free(slot)
+        if self.adapter_pool is not None:
+            self.adapter_pool.free_slot(slot)
         self._slots[slot] = None
         reg = registry()
         reg.counter("serve_migrations_exported").inc()
@@ -856,8 +945,31 @@ class Engine:
                 "no free slot for the migrated request (callers check "
                 "for one before installing)"
             )
-        # Consumes the lease: released on every import failure path.
-        self.cache.import_request(meta, slot, lease=lease)
+        tenant_pinned = False
+        if req.tenant is not None:
+            if self.adapter_pool is None or not (
+                self.adapter_pool.knows(req.tenant)
+            ):
+                self.cache.release_lease(lease[1] if lease else None)
+                raise MigrationCompatError(
+                    f"migrated request is tenant {req.tenant!r} but "
+                    f"this engine's adapter pool does not serve it"
+                )
+            # Re-pin the tenant's adapter HERE (loading it into this
+            # pool if needed) before any KV lands: resuming a tenant's
+            # decode against the bare base model would silently change
+            # its tokens.
+            self.adapter_pool.acquire(req.tenant)
+            tenant_pinned = True
+        try:
+            # Consumes the lease: released on every import failure path.
+            self.cache.import_request(meta, slot, lease=lease)
+            if self.adapter_pool is not None:
+                self.adapter_pool.bind_slot(slot, req.tenant)
+        except BaseException:
+            if tenant_pinned:
+                self.adapter_pool.release(req.tenant)
+            raise
         s = _Slot(
             entry, int(meta["tokens"][0]), int(meta["prompt_ids_len"]),
             float(meta["t_seated"]), float(meta["t_first"]),
@@ -908,10 +1020,17 @@ class Engine:
     def _fits_migrated(self, meta: dict) -> bool:
         """Can this payload's reservation seat RIGHT NOW? The radix
         path credits the (pre-leased) matched prefix exactly like
-        ``fits_request`` does for fresh prompts."""
+        ``fits_request`` does for fresh prompts; a tenant-ful payload
+        additionally needs its adapter securable in this pool."""
         reserve = int(meta["reserve_tokens"])
         if reserve > self.max_seq_len:
             return False
+        tenant = meta["request"].get("tenant")
+        if tenant is not None:
+            if self.adapter_pool is None or not (
+                self.adapter_pool.can_seat(tenant)
+            ):
+                return False
         if self.prefix_share and meta.get("left_aligned"):
             return self.cache.fits_request(
                 meta["request"]["input_ids"], reserve
@@ -922,6 +1041,12 @@ class Engine:
         reserve = int(meta["reserve_tokens"])
         if reserve > self.max_seq_len:
             return False
+        tenant = meta["request"].get("tenant")
+        if tenant is not None:
+            if self.adapter_pool is None or not (
+                self.adapter_pool.can_ever_seat(tenant)
+            ):
+                return False
         return self.cache.pages_needed(reserve) <= self.cache.num_pages - 1
 
     # -- stepping ------------------------------------------------------
@@ -972,6 +1097,10 @@ class Engine:
         self.cache.free(slot)
         if self.speculator is not None:
             self.speculator.free(slot)
+        if self.adapter_pool is not None:
+            # Drops the slot's tenant pin; the adapter stays CACHED at
+            # refcount 0 (the evictable pool) for the next request.
+            self.adapter_pool.free_slot(slot)
         self._slots[slot] = None
 
     def _decode_step(self) -> None:
@@ -998,7 +1127,13 @@ class Engine:
             steps[i] = s.steps
         rec = active_recorder()
         t0 = self.clock()
-        if self.paged:
+        if self.adapter_pool is not None:
+            logits, self.cache.cache = self.decode_call(
+                self.params, self.cache.cache, tokens, positions,
+                *self.cache.dispatch_args(),
+                *self.adapter_pool.dispatch_args(),
+            )
+        elif self.paged:
             logits, self.cache.cache = self.decode_call(
                 self.params, self.cache.cache, tokens, positions,
                 *self.cache.dispatch_args(),

@@ -352,6 +352,35 @@ class Replica:
         except Exception:
             return 0
 
+    def adapter_resident_since(self, tenant) -> Optional[float]:
+        """When this replica's adapter pool loaded ``tenant``'s LoRA
+        pages (None = not resident / no pool) — the router's
+        adapter-affinity probe, the prefix-affinity shape applied to
+        adapters: the replica holding the adapter LONGEST wins ties,
+        so a tenant's stream keeps hitting warm pages instead of
+        forcing a load on every replica. Read-only and lock-guarded
+        inside the pool."""
+        try:
+            pool = self.session.engine.adapter_pool
+            return (
+                pool.resident_since(tenant) if pool is not None else None
+            )
+        except Exception:
+            return None
+
+    def serves_tenant(self, tenant) -> bool:
+        """Whether this replica's pool can serve ``tenant`` at all
+        (registered + rank fits the pool) — the migration-target
+        filter: resuming a tenant's decode on a replica without its
+        adapter would silently change tokens."""
+        if tenant is None:
+            return True
+        try:
+            pool = self.session.engine.adapter_pool
+            return pool is not None and pool.can_ever_seat(tenant)
+        except Exception:
+            return False
+
     # -- the replica thread --------------------------------------------
 
     def start(self) -> "Replica":
@@ -694,6 +723,8 @@ class Router:
         migrate: bool = True,
         migrate_timeout_s: float = 2.0,
         max_failovers: Optional[int] = None,
+        tenant_classes: Optional[Dict[Any, dict]] = None,
+        tenant_quota_tokens: Optional[int] = None,
     ):
         if not replicas:
             raise ValueError("Router needs at least one replica")
@@ -727,6 +758,24 @@ class Router:
             max_failovers
             if max_failovers is not None
             else env_int("TPUDL_SERVE_MAX_FAILOVERS", 3)
+        )
+        #: Per-tenant serving classes on top of the existing priority
+        #: classes: ``{tenant: {"priority": int, "max_inflight_tokens":
+        #: int}}``. ``priority`` maps the tenant onto the SLO shed
+        #: ladder (priority > shed_priority_above sheds first under
+        #: burn — a tenant's latency class is one line of config);
+        #: ``max_inflight_tokens`` caps the tenant's outstanding token
+        #: budget — past it, its requests shed as ``shed_quota`` at the
+        #: door, so one tenant's overload cannot queue out everyone
+        #: else (the isolation bar benchmarks/serve_load.py --tenants
+        #: asserts). ``tenant_quota_tokens`` (or
+        #: ``TPUDL_SERVE_TENANT_QUOTA_TOKENS``) is the default quota
+        #: for tenants without an explicit class; None = unlimited.
+        self.tenant_classes: Dict[Any, dict] = dict(tenant_classes or {})
+        self.tenant_quota_tokens = (
+            tenant_quota_tokens
+            if tenant_quota_tokens is not None
+            else env_int("TPUDL_SERVE_TENANT_QUOTA_TOKENS")
         )
         self.results: Dict[Any, Result] = {}
         self._assigned: Dict[Any, Any] = {}  # rid -> (replica_name|None, Request)
@@ -919,6 +968,7 @@ class Router:
         exclude: str,
         source_cache,
         tentative: Dict[str, int],
+        request: Optional[Request] = None,
     ) -> Optional[Replica]:
         """Least-loaded ready survivor whose cache can SEAT the
         payload (paged, same KV quantization) — chosen BEFORE the
@@ -939,6 +989,13 @@ class Router:
                 and bool(
                     getattr(r.session.engine.cache, "quantized", False)
                 ) == quantized
+                # Tenant requests only resume where the adapter can be
+                # re-pinned (install would refuse anyway; filtering
+                # here avoids exporting a payload no survivor seats).
+                and (
+                    request is None
+                    or r.serves_tenant(request.tenant)
+                )
             ]
             if not ready:
                 return None
@@ -983,7 +1040,7 @@ class Router:
             tentative: Dict[str, int] = {}
             for rid, req in doomed.items():
                 target = self._pick_migration_target(
-                    name, source_cache, tentative
+                    name, source_cache, tentative, request=req
                 )
                 if target is None:
                     continue  # no survivor: resubmission will shed
@@ -1224,10 +1281,40 @@ class Router:
         (priority > shed_priority_above) shed at the door."""
         rid = request.request_id
         validate_request(request, self._prompt_len, self._max_seq_len)
+        if request.tenant is not None and self.prefill_workers:
+            raise ValueError(
+                "disaggregated prefill does not support tenant "
+                "adapters yet (the prefill workers run the plain base "
+                "program — a tenant's prompt would prefill unadapted)"
+            )
         self._scrape()
         with self._books:
             if rid in self._assigned or rid in self.results:
                 raise ValueError(f"duplicate request_id {rid!r}")
+            if request.tenant is not None:
+                cls = self.tenant_classes.get(request.tenant, {})
+                if "priority" in cls and (
+                    request.priority != cls["priority"]
+                ):
+                    # The tenant's SLO class IS its priority: map it
+                    # onto the existing shed ladder at the door.
+                    request = dataclasses.replace(
+                        request, priority=cls["priority"]
+                    )
+                quota = cls.get(
+                    "max_inflight_tokens", self.tenant_quota_tokens
+                )
+                if quota is not None and (
+                    self._tenant_inflight(request.tenant)
+                    + request.max_new_tokens
+                    > quota
+                ):
+                    # Over its token budget: the tenant sheds at the
+                    # DOOR, before any queue position is consumed —
+                    # one tenant's 4x overload must not move its
+                    # neighbors' tail (the isolation contract).
+                    self._shed(request, "shed_quota")
+                    return rid
             if (
                 self.burning
                 and request.priority > self.shed_priority_above
@@ -1287,9 +1374,26 @@ class Router:
             )
         return rid
 
+    def _tenant_inflight(self, tenant) -> int:
+        """Outstanding token budget one tenant holds (sum of assigned
+        requests' max_new_tokens). Derived from ``_assigned`` on read
+        instead of counter-maintained: every mutation site of the
+        assignment book would otherwise need a paired tenant-side
+        update, and a single missed pair skews the quota forever.
+        Callers hold ``_books``."""
+        return sum(
+            req.max_new_tokens
+            for _, req in self._assigned.values()
+            if req.tenant == tenant
+        )
+
     def _pick(self, request: Request) -> Optional[Replica]:
         """Sticky pin first (if its replica is still ready), then
-        PREFIX AFFINITY — the ready replica whose radix tree holds the
+        ADAPTER AFFINITY for tenant requests — the ready replica whose
+        pool has held this tenant's adapter RESIDENT longest wins
+        (warm pages beat a less-loaded replica paying a fresh load;
+        the prefix-affinity shape applied to adapters) — then PREFIX
+        AFFINITY — the ready replica whose radix tree holds the
         longest cached prefix of this prompt (at least one full page)
         serves it with O(unshared suffix) prefill, which beats a
         less-loaded cold replica re-paying the whole window — then
@@ -1302,10 +1406,42 @@ class Router:
                 and self._ready.get(pinned)
                 and pinned not in self._draining
             ):
-                return next(
+                target = next(
                     r for r in self.replicas if r.name == pinned
                 )
+                # A pin set by this session's tenantless (or other-
+                # tenant) traffic must not route a tenant request to a
+                # replica that cannot serve its adapter.
+                if target.serves_tenant(request.tenant):
+                    return target
         ready = self._ready_replicas()
+        if request.tenant is not None:
+            # Only replicas that can serve this tenant at all: placing
+            # on one that cannot would terminally reject the request
+            # at the replica door even while a serving replica idles
+            # (the same filter the migration target pick applies).
+            ready = [
+                r for r in ready if r.serves_tenant(request.tenant)
+            ]
+            if not ready:
+                return None
+        if request.tenant is not None and len(ready) > 1:
+            resident = [
+                (since, r)
+                for r in ready
+                for since in [r.adapter_resident_since(request.tenant)]
+                if since is not None
+            ]
+            if resident:
+                # Longest-resident wins: the earliest load stamp —
+                # recency churn would bounce a tenant between
+                # replicas, each load evicting someone else's pages.
+                best = min(since for since, _ in resident)
+                contenders = [r for since, r in resident if since == best]
+                return min(
+                    contenders,
+                    key=lambda r: (self._inflight[r.name], r.load),
+                )
         if len(ready) > 1:
             matches = [
                 (r.prefix_match_len(request.input_ids), r) for r in ready
@@ -1317,7 +1453,12 @@ class Router:
                     contenders,
                     key=lambda r: (self._inflight[r.name], r.load),
                 )
-        return self._least_loaded()
+        # Least-loaded over the (possibly tenant-filtered) ready set.
+        if not ready:
+            return None
+        return min(
+            ready, key=lambda r: (self._inflight[r.name], r.load)
+        )
 
     def _place_prefilled(self, item) -> None:
         """PrefillWorker completion hook (worker thread): hand the
