@@ -165,9 +165,16 @@ def _bench_resnet50():
     return RESNET50_BATCH * RESNET50_MEASURE_STEPS / elapsed / jax.device_count()
 
 
-def _bench_bert(fused_ops=False, warmup=None, measure=None):
+def _bench_bert(fused_ops=False, warmup=None, measure=None,
+                precision=None):
     """BERT-base fine-tune step: samples/sec/chip and MFU (compiled-cost
     FLOPs, 6ND transformer fallback).
+
+    ``precision`` (a tpudl.train.precision preset name) measures the
+    SAME workload under that mixed-precision policy — the ROADMAP
+    item-6 training variant, reported as ``bert_base_mfu_bf16`` next
+    to the headline. Lean step counts, and the fused-dispatch
+    sub-bench is skipped (measured once, on the headline path).
 
     ``fused_ops=True`` measures the SAME workload with the fused
     epilogue tier on (Pallas LayerNorm+residual / bias+GeLU via
@@ -211,6 +218,7 @@ def _bench_bert(fused_ops=False, warmup=None, measure=None):
         model,
         jnp.zeros((1, BERT_SEQ), jnp.int32),
         make_optimizer(ocfg),
+        precision=precision,
     )
     num_params = sum(p.size for p in jax.tree.leaves(state.params))
     mesh = make_mesh(MeshSpec(dp=-1))
@@ -218,10 +226,12 @@ def _bench_bert(fused_ops=False, warmup=None, measure=None):
         make_classification_train_step(
             input_keys=("input_ids", "attention_mask"), label_key="label",
             loss_impl="auto" if fused_ops else "reference",
+            precision=precision,
         ),
         mesh,
         state,
         None,
+        precision=precision,
     )
 
     batch = next(
@@ -276,7 +286,7 @@ def _bench_bert(fused_ops=False, warmup=None, measure=None):
     # path).
     fused = {}
     try:
-        if fused_ops:
+        if fused_ops or precision is not None:
             return samples_per_sec, mfu(
                 flops, step_seconds, jax.device_count(),
                 device_peak_flops(),
@@ -537,6 +547,29 @@ def _bench_ft():
     return measure_ft()
 
 
+def _bench_train_precision():
+    """Mixed-precision TRAINING tier (tpudl.train.precision +
+    tpudl.ops.fp8_dot via benchmarks/train_precision.py): every
+    precision cell loss-parity gated against the f32 control on a
+    fixed-seed run (the assertion lives in the benchmark), the fp8
+    cell's weight+activation bytes-moved ratio (the speedup ceiling;
+    >= 2x asserted, model says ~4x), and the passed-cell count —
+    the training-side mirror of the serving parity grid."""
+    from benchmarks.train_precision import run_precision_sweep
+    from tpudl.ops.attention import is_tpu_backend
+
+    sweep = run_precision_sweep(smoke=not is_tpu_backend())
+    return {
+        "train_precision_parity_cells": sweep["parity_cells_passed"],
+        "train_precision_parity_cells_total": sweep[
+            "parity_cells_total"
+        ],
+        "train_fp8_bytes_ratio": sweep.get(
+            "fp8_weight_act_bytes_ratio"
+        ),
+    }
+
+
 def _regression_gate(result: dict, strict: bool) -> int:
     """Advisory noise-aware regression check of this run against the
     banked BENCH_r*.json history (scripts/bench_regress.py — the
@@ -599,6 +632,21 @@ def main(argv=None):
         print("fused-ops bench variant failed:", file=sys.stderr)
         traceback.print_exc()
         fo_sps = fo_mfu = None
+    try:
+        # Mixed-precision training variant (tpudl.train.precision
+        # "bf16" policy: rule-cast bf16 compute, f32 masters, f32
+        # reductions) — the ROADMAP item-6 training half, lean step
+        # counts like the fused-ops variant.
+        bf16_sps, bf16_mfu, _ = _bench_bert(
+            precision="bf16", warmup=10, measure=20
+        )
+    except Exception:
+        import sys
+        import traceback
+
+        print("bf16-precision bench variant failed:", file=sys.stderr)
+        traceback.print_exc()
+        bf16_sps = bf16_mfu = None
     resnet_ips = _bench_resnet()
     resnet50_ips = _bench_resnet50()
     bl_sps, bl_mfu, bl_mfu_compiled = _bench_bert_large()
@@ -695,6 +743,15 @@ def main(argv=None):
         print("block-pin sweep failed:", file=sys.stderr)
         traceback.print_exc()
         block_pins = {}
+    try:
+        train_prec = _bench_train_precision()
+    except Exception:
+        import sys
+        import traceback
+
+        print("train-precision bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        train_prec = {}
 
     vs_baseline = (
         bert_sps / BASELINE_BERT_SAMPLES_PER_SEC
@@ -732,6 +789,27 @@ def main(argv=None):
         "bert_base_fused_ops_samples_per_sec": round(fo_sps, 1)
         if fo_sps is not None
         else None,
+        # Mixed-precision training tier (tpudl.train.precision +
+        # tpudl.ops.fp8_dot via benchmarks/train_precision.py): the
+        # bf16-policy BERT-base MFU variant, the fp8 cell's
+        # weight+activation bytes-moved ratio vs f32 (the speedup
+        # ceiling — the bytes model says ~4x, >= 2x asserted in the
+        # benchmark), and the loss-parity cell count (every cell
+        # gated against the fixed-seed f32 control inside the
+        # benchmark; a failed gate raises there, so a banked count
+        # means every band held).
+        "bert_base_mfu_bf16": round(bf16_mfu, 4)
+        if bf16_mfu is not None
+        else None,
+        "bert_base_bf16_samples_per_sec": round(bf16_sps, 1)
+        if bf16_sps is not None
+        else None,
+        "train_fp8_bytes_ratio": train_prec.get(
+            "train_fp8_bytes_ratio"
+        ),
+        "train_precision_parity_cells": train_prec.get(
+            "train_precision_parity_cells"
+        ),
         "resnet50_imagenet_images_per_sec_chip": round(resnet50_ips, 1),
         "resnet50_vs_baseline": round(
             resnet50_ips / BASELINE_RESNET50_IMAGES_PER_SEC, 3

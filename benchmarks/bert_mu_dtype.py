@@ -1,3 +1,15 @@
+"""AdamW first-moment dtype A/B on the BERT-base step (bf16 vs f32 mu).
+
+Routed through ``tpudl.train.precision`` since the mixed-precision
+tier landed: the bf16 arm is the policy's rule-selected moment cast
+(``PrecisionPolicy.moment_rules`` via ``apply_moment_rules``) — the
+SAME code path ``create_train_state(precision=...)`` and the ISSUE-15
+training tier use — instead of hand-wiring ``optax.adamw(mu_dtype=...)``
+here, so this benchmark and the policy cannot drift apart. (The two
+are numerically identical: moments promote to f32 inside the update
+and re-cast to storage, exactly optax's ``mu_dtype`` contract —
+tests/test_precision.py pins the equivalence.)
+"""
 import pathlib as _pathlib, sys as _sys
 _sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
 
@@ -7,12 +19,18 @@ from tpudl.data.synthetic import synthetic_token_batches
 from tpudl.models.bert import BertConfig, BertForSequenceClassification
 from tpudl.runtime import MeshSpec, make_mesh, use_hardware_rng
 from tpudl.train import compile_step, create_train_state, make_classification_train_step
+from tpudl.train.precision import PrecisionPolicy, apply_moment_rules
 use_hardware_rng()
 MU = sys.argv[1]
 if MU not in ("bf16", "f32"):
     raise SystemExit(f"usage: bert_mu_dtype.py bf16|f32 (got {MU!r})")
-tx = optax.adamw(2e-5, weight_decay=0.01,
-                 mu_dtype=jnp.bfloat16 if MU == "bf16" else None)
+# The f32 arm is the identity wrap (no moment rules); the bf16 arm is
+# the policy's moment cast — one rule, every mu leaf.
+pol = PrecisionPolicy(
+    name=f"mu_{MU}",
+    moment_rules=((r".*", "bfloat16"),) if MU == "bf16" else (),
+)
+tx = apply_moment_rules(optax.adamw(2e-5, weight_decay=0.01), pol)
 mesh = make_mesh(MeshSpec(dp=-1))
 cfg = BertConfig()
 model = BertForSequenceClassification(cfg)

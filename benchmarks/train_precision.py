@@ -1,0 +1,374 @@
+"""Mixed-precision TRAINING sweep: f32 / bf16 / fp8 train-step cells,
+each loss-parity gated against the f32 control and priced by the
+bytes-moved model (the speedup ceiling) next to measured step time.
+
+The training-side mirror of benchmarks/parity_grid.py (which prices
+the SERVING precision matrix): one fixed-seed BERT fine-tune workload
+runs once per precision cell —
+
+- ``f32``     — policy=None, the exact legacy step (the control);
+- ``bf16``    — ``tpudl.train.precision.policy("bf16")``: rule-matched
+  kernels/embeddings compute in bf16, f32 masters, f32 loss reduction;
+- ``bf16_m8`` — bf16 + rule-selected bf16 AdamW first moments (the
+  optimizer-memory win);
+- ``fp8``     — ``policy("fp8")`` on a model built with
+  ``fp8_train=True``: the rule-class projection matmuls run e4m3
+  forward / e5m2 gradient with delayed scaling + dynamic loss scaling.
+
+Every cell's FINAL loss must sit inside its documented tolerance band
+of the control (PARITY_BANDS — the acceptance gate bench.py banks as
+``train_precision_parity_cells``), and the fp8 cell's weight+activation
+bytes-moved ratio vs f32 must clear 2x (``train_fp8_bytes_ratio``; the
+model says 4x — fp8 halves bf16's bytes again).
+
+Bytes model (per projection site with kernel [K, N] and T tokens per
+step, counting only the rule-class matmul sites — everything else is
+precision-invariant across cells): the forward reads W and x, the
+input-grad matmul reads W and g, the weight-grad matmul reads x and g,
+so weight bytes = 2·K·N·p_w, activation bytes = 2·T·K·p_x, gradient
+bytes = 2·T·N·p_g at each precision's bytes-per-element. fp8 adds the
+per-site scale/amax state (three f32 rings + probe) — counted as
+``overhead_bytes`` and visibly negligible.
+
+Usage::
+
+    python -m benchmarks.train_precision            # full sweep
+    python -m benchmarks.train_precision --smoke    # 1-vCPU plumbing
+    python -m benchmarks.train_precision --steps 60 --cells f32,bf16
+"""
+
+from __future__ import annotations
+
+import pathlib as _pathlib
+import sys as _sys
+
+_sys.path.insert(0, str(_pathlib.Path(__file__).resolve().parents[1]))
+
+import argparse
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpudl import rules as rules_engine
+from tpudl.models.bert import BertConfig, BertForSequenceClassification
+from tpudl.quant.quantize import BERT_QUANT_PATTERNS
+from tpudl.runtime import MeshSpec, make_mesh
+from tpudl.train import (
+    compile_step,
+    create_train_state,
+    make_classification_train_step,
+)
+from tpudl.train import precision as precision_mod
+
+#: |final_loss(cell) - final_loss(f32)| acceptance bands. bf16 carries
+#: f32's exponent range, so only mantissa rounding accumulates; fp8
+#: adds the e4m3/e5m2 grids on every projection matmul — wider band,
+#: still a small fraction of the ~0.69 two-class loss floor. A cell
+#: outside its band is a policy/kernel bug, not noise: the workload is
+#: fixed-seed and dropout-free, so the only divergence source IS the
+#: precision.
+PARITY_BANDS = {"bf16": 0.03, "bf16_m8": 0.03, "fp8": 0.08}
+
+#: Bytes per element of (activation, weight, gradient) per cell — the
+#: fp8 row is the e4m3/e4m3/e5m2 split (1 byte each).
+CELL_BYTES = {
+    "f32": (4, 4, 4),
+    "bf16": (2, 2, 2),
+    "bf16_m8": (2, 2, 2),
+    "fp8": (1, 1, 1),
+}
+
+DEFAULT_CELLS = ("f32", "bf16", "bf16_m8", "fp8")
+
+
+def _bench_config(smoke: bool) -> BertConfig:
+    """Fixed-seed, dropout-free BERT: any cross-cell divergence is the
+    precision, never the mask stream."""
+    if smoke:
+        return BertConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_position_embeddings=32,
+            num_labels=2, dtype=jnp.float32,
+            hidden_dropout=0.0, attention_dropout=0.0,
+        )
+    return BertConfig(
+        vocab_size=512, hidden_size=64, num_layers=4, num_heads=4,
+        intermediate_size=128, max_position_embeddings=64,
+        num_labels=2, dtype=jnp.float32,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+
+
+def _policy_for(cell: str):
+    if cell == "f32":
+        return None
+    if cell == "bf16":
+        return precision_mod.policy("bf16")
+    if cell == "bf16_m8":
+        return precision_mod.policy("bf16", bf16_moments=True)
+    if cell == "fp8":
+        return precision_mod.policy("fp8")
+    raise ValueError(f"unknown precision cell {cell!r}")
+
+
+def _batches(n: int, batch: int, seq: int, vocab: int, seed: int):
+    """The SAME fixed-seed batch stream for every cell."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        out.append({
+            "input_ids": jnp.asarray(
+                rng.integers(1, vocab, (batch, seq)), jnp.int32
+            ),
+            "attention_mask": jnp.ones((batch, seq), jnp.int32),
+            "label": jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32),
+        })
+    return out
+
+
+def projection_traffic_bytes(
+    params: Any,
+    tokens: int,
+    cell: str,
+    patterns: Sequence[str] = BERT_QUANT_PATTERNS,
+) -> Dict[str, float]:
+    """Per-step weight/activation/gradient traffic of the rule-class
+    matmul sites at one cell's precisions (module docstring model).
+    ``tokens`` = batch * seq — the rows every projection processes."""
+    act_b, w_b, g_b = CELL_BYTES[cell]
+    rules = tuple((p, True) for p in patterns) + ((r".*", None),)
+    weight = act = grad = 0
+    n_sites = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = rules_engine.path_str(path)
+        if jnp.ndim(leaf) < 2:
+            continue
+        if rules_engine.first_match(rules, name) is not True:
+            continue
+        k, n = leaf.shape[-2], leaf.shape[-1]
+        n_sites += 1
+        weight += 2 * k * n * w_b
+        act += 2 * tokens * k * act_b
+        grad += 2 * tokens * n * g_b
+    overhead = 0
+    if cell == "fp8":
+        from tpudl.ops.fp8_dot import default_amax_window
+
+        # Three amax rings + probe + three derived scales, f32 each.
+        overhead = n_sites * 4 * (3 * default_amax_window() + 4)
+    total = weight + act + grad + overhead
+    return {
+        "sites": n_sites,
+        "weight_bytes": weight,
+        "activation_bytes": act,
+        "grad_bytes": grad,
+        "overhead_bytes": overhead,
+        "weight_act_bytes": weight + act + overhead,
+        "total_bytes": total,
+    }
+
+
+def run_cell(
+    cell: str,
+    steps: int,
+    batches,
+    cfg: BertConfig,
+    mesh,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """One fixed-seed training run at one precision; returns losses and
+    measured per-step wall time (steady state: first two steps —
+    compile + settle — excluded from the timing)."""
+    pol = _policy_for(cell)
+    model_cfg = cfg
+    if pol is not None:
+        # The compute dtype rides the model's dtype seam (a flax
+        # module re-promotes params to its own dtype, so only the
+        # seam moves the matmul precision) — the bf16/fp8 cells
+        # genuinely run bf16 activations/matmuls, not rounded-f32.
+        model_cfg = pol.configure_model(cfg)
+    if pol is not None and pol.use_fp8:
+        import dataclasses
+
+        # "force" exercises the real fp8 kernels everywhere (native f8
+        # dot_general on CPU too) — the auto seam picks the same path
+        # on TPU.
+        model_cfg = dataclasses.replace(model_cfg, fp8_train="force")
+    model = BertForSequenceClassification(model_cfg)
+    tx = optax.adamw(1e-3)
+    state = create_train_state(
+        jax.random.key(seed), model,
+        jnp.zeros((1, batches[0]["input_ids"].shape[1]), jnp.int32),
+        tx, precision=pol,
+    )
+    step = compile_step(
+        make_classification_train_step(
+            input_keys=("input_ids", "attention_mask"),
+            label_key="label",
+            precision=pol,
+        ),
+        mesh, state, None, precision=pol,
+    )
+    rng = jax.random.key(seed + 1)
+    losses = []
+    t0 = None
+    timed = 0
+    for i in range(steps):
+        if i == min(2, steps - 1):
+            jax.block_until_ready(state.params)
+            t0 = time.perf_counter()
+        state, metrics = step(state, batches[i % len(batches)], rng)
+        losses.append(float(metrics["loss"]))
+        if t0 is not None:
+            timed += 1
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - t0 if t0 is not None else 0.0
+    out = {
+        "cell": cell,
+        "losses": losses,
+        "final_loss": losses[-1],
+        "step_ms": round(elapsed / max(timed, 1) * 1e3, 3),
+    }
+    if pol is not None and pol.loss_scale is not None:
+        out["loss_scale"] = float(metrics["loss_scale"])
+        out["skipped_steps"] = int(
+            np.asarray(state.precision["loss_scale"]["skipped"])
+        )
+    out["_params"] = state.params
+    return out
+
+
+def run_precision_sweep(
+    cells: Sequence[str] = DEFAULT_CELLS,
+    steps: int = 40,
+    smoke: bool = False,
+    seed: int = 0,
+    batch: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The acceptance sweep: every requested cell runs the same
+    fixed-seed workload; parity is judged against the f32 control
+    (which is always run, even if not requested) and the bytes model
+    prices each cell. Asserts the ISSUE-15 gates: every cell inside
+    its band, fp8 weight+activation ratio >= 2x."""
+    if smoke:
+        steps = min(steps, 12)
+    cfg = _bench_config(smoke)
+    batch = batch or (8 if smoke else 16)
+    seq = cfg.max_position_embeddings // 2
+    mesh = make_mesh(MeshSpec(dp=-1))
+    batches = _batches(min(steps, 16), batch, seq, cfg.vocab_size, seed)
+    tokens = batch * seq
+
+    control = run_cell("f32", steps, batches, cfg, mesh, seed)
+    f32_bytes = projection_traffic_bytes(
+        control.pop("_params"), tokens, "f32"
+    )
+    results = {"f32": {**control, "bytes": f32_bytes, "parity": None}}
+    passed = 1  # the control trivially occupies its own cell
+    for cell in cells:
+        if cell == "f32":
+            continue
+        res = run_cell(cell, steps, batches, cfg, mesh, seed)
+        cell_bytes = projection_traffic_bytes(
+            res.pop("_params"), tokens, cell
+        )
+        diff = abs(res["final_loss"] - control["final_loss"])
+        band = PARITY_BANDS[cell]
+        ok = diff <= band
+        passed += int(ok)
+        results[cell] = {
+            **res,
+            "bytes": cell_bytes,
+            "parity": {
+                "final_loss_diff": round(diff, 6),
+                "band": band,
+                "pass": ok,
+            },
+            "bytes_ratio_vs_f32": round(
+                f32_bytes["total_bytes"] / cell_bytes["total_bytes"], 3
+            ),
+            "weight_act_ratio_vs_f32": round(
+                f32_bytes["weight_act_bytes"]
+                / cell_bytes["weight_act_bytes"],
+                3,
+            ),
+        }
+    summary = {
+        "steps": steps,
+        "tokens_per_step": tokens,
+        "cells": results,
+        "parity_cells_passed": passed,
+        "parity_cells_total": 1 + sum(1 for c in cells if c != "f32"),
+    }
+    if "fp8" in results:
+        ratio = results["fp8"]["weight_act_ratio_vs_f32"]
+        summary["fp8_weight_act_bytes_ratio"] = ratio
+        assert ratio >= 2.0, (
+            f"fp8 weight+activation bytes ratio {ratio} under the 2x "
+            f"bar — the bytes model says 4x; the rule classes stopped "
+            f"matching the projection sites"
+        )
+    for cell, res in results.items():
+        if res["parity"] is not None:
+            assert res["parity"]["pass"], (
+                f"precision cell {cell!r} final loss diverged "
+                f"{res['parity']['final_loss_diff']} > band "
+                f"{res['parity']['band']} from the f32 control"
+            )
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="mixed-precision train-step sweep (bytes model + "
+        "loss parity vs the f32 control)"
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny cells for 1-vCPU plumbing checks")
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--cells", default=None,
+                    help="comma list from f32,bf16,bf16_m8,fp8 "
+                    "(default: all; TPUDL_TRAIN_PRECISION=<name> "
+                    "narrows the default to f32 + that cell)")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.cells is None:
+        env_pol = precision_mod.policy_from_env()
+        cells = (
+            ("f32", env_pol.name) if env_pol is not None
+            else DEFAULT_CELLS
+        )
+    else:
+        cells = tuple(
+            c.strip() for c in args.cells.split(",") if c.strip()
+        )
+    out = run_precision_sweep(
+        cells=cells, steps=args.steps, smoke=args.smoke, seed=args.seed
+    )
+    print(f"{'cell':8} {'final loss':>11} {'Δ vs f32':>10} {'band':>6} "
+          f"{'step ms':>8} {'bytes/step':>12} {'ceiling':>8}")
+    f32_t = out["cells"]["f32"]["bytes"]["total_bytes"]
+    for cell, res in out["cells"].items():
+        diff = ("-" if res["parity"] is None
+                else f"{res['parity']['final_loss_diff']:.5f}")
+        band = ("-" if res["parity"] is None
+                else f"{res['parity']['band']:.2f}")
+        ceil = f"{f32_t / res['bytes']['total_bytes']:.2f}x"
+        print(f"{cell:8} {res['final_loss']:11.5f} {diff:>10} {band:>6} "
+              f"{res['step_ms']:8.2f} {res['bytes']['total_bytes']:12,} "
+              f"{ceil:>8}")
+    print(f"parity cells: {out['parity_cells_passed']}"
+          f"/{out['parity_cells_total']} passed"
+          + (f"; fp8 weight+act bytes ratio "
+             f"{out['fp8_weight_act_bytes_ratio']}x (bar 2x)"
+             if "fp8_weight_act_bytes_ratio" in out else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,6 +122,16 @@ NOISE_BAND_FLOORS = {
     # loop hand-off plus a decode step), so the bands stay wide.
     "serve_drain_p99_ms": 0.60,
     "failover_token_gap_ms": 0.60,
+    # Mixed-precision training keys (benchmarks/train_precision.py +
+    # the bf16-policy BERT variant, banked from r09). The bytes ratio
+    # is pure arithmetic over the rule-class sites (drift = the rules
+    # stopped matching); the parity cell count only moves when a cell
+    # is added or a band breaks — one lost cell must gate; the bf16
+    # MFU variant rides the same relay jitter as the headline BERT
+    # metrics.
+    "train_fp8_bytes_ratio": 0.05,
+    "train_precision_parity_cells": 0.01,
+    "bert_base_mfu_bf16": 0.10,
 }
 DEFAULT_BAND_FLOOR = 0.08
 

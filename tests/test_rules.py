@@ -1,0 +1,239 @@
+"""The shared rules engine (tpudl.rules) — ROADMAP item 4's first
+clause: ONE regex-over-path, first-match-wins, uncovered→raise
+machinery behind quantization dtypes, PartitionSpecs, and precision
+policies.
+
+Contracts: (1) RESOLUTION — first_match semantics are exactly the
+loops it replaced (search not fullmatch, first rule wins, None is a
+legal value distinct from NO_MATCH), and the ported quantizer resolves
+bitwise-identically to an inline reimplementation of its pre-factoring
+private loop; (2) PLACEMENT — match_partition_rules produces the
+SNIPPETS.md [2] shape (scalars replicate, callable specs see the leaf
+shape, uncovered raises naming the leaf) over params AND optimizer
+state in one call, and agrees with parallel.sharding.spec_for_path on
+every covered leaf so the two consumers cannot drift.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpudl import rules as rules_engine
+from tpudl.models.llama import LLAMA_TINY, LlamaForCausalLM
+from tpudl.parallel.sharding import (
+    FSDP_RULES,
+    TP_TRANSFORMER_RULES,
+    spec_for_path,
+)
+from tpudl.quant.quantize import (
+    LLAMA_QUANT_PATTERNS,
+    default_quant_rules,
+    is_quantized,
+    match_quant_rules,
+)
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    cfg = LLAMA_TINY(dtype=jnp.float32, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    return model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# 1. first_match — the one resolution primitive
+# ---------------------------------------------------------------------------
+
+
+def test_first_match_first_rule_wins():
+    rules = ((r"kernel$", "a"), (r"query/kernel$", "b"), (r".*", "c"))
+    assert rules_engine.first_match(rules, "x/query/kernel") == "a"
+    assert rules_engine.first_match(rules, "x/bias") == "c"
+
+
+def test_first_match_is_search_not_fullmatch():
+    assert (
+        rules_engine.first_match(((r"proj/kernel$", 1),),
+                                 "layers_0/q_proj/kernel") == 1
+    )
+    assert (
+        rules_engine.first_match(((r"^q_proj", 1),),
+                                 "layers_0/q_proj/kernel")
+        is rules_engine.NO_MATCH
+    )
+
+
+def test_first_match_none_value_distinct_from_no_match():
+    """A rule matching with value None is a decision ("keep"), not a
+    miss — the distinction the quantizer's uncovered→raise rests on."""
+    assert rules_engine.first_match(((r".*", None),), "x/kernel") is None
+    assert (
+        rules_engine.first_match((), "x/kernel") is rules_engine.NO_MATCH
+    )
+
+
+def test_annotate_uncovered_raises_naming_leaf():
+    with pytest.raises(ValueError, match=r"no dtype rule.*mystery/kernel"):
+        rules_engine.annotate(
+            ((r"other$", "x"),),
+            {"mystery": {"kernel": jnp.ones((2, 2))}},
+            what="dtype rule",
+        )
+
+
+# ---------------------------------------------------------------------------
+# 2. The ported quantizer resolves bitwise-identically
+# ---------------------------------------------------------------------------
+
+
+def _legacy_dtype_for(name, leaf, rules):
+    """The pre-factoring private loop, reimplemented inline — the
+    resolution semantics tpudl.quant shipped with in PR 9."""
+    if is_quantized(leaf) or jnp.ndim(leaf) < 2:
+        return None
+    for pattern, dtype in rules:
+        if re.search(pattern, name):
+            return dtype
+    raise ValueError(f"no quantization rule matches parameter {name!r}")
+
+
+def test_quant_resolution_identical_to_legacy_loop(llama_params):
+    rules = default_quant_rules(LLAMA_TINY(), "int8")
+    engine = match_quant_rules(rules, llama_params)
+    legacy = jax.tree_util.tree_map_with_path(
+        lambda p, leaf: _legacy_dtype_for(
+            rules_engine.path_str(p), leaf, rules
+        ),
+        llama_params,
+        is_leaf=is_quantized,
+    )
+    assert jax.tree.structure(engine) == jax.tree.structure(legacy)
+    assert jax.tree.leaves(engine) == jax.tree.leaves(legacy)
+    # Sanity: the rule classes actually fire (some int8 annotations).
+    assert "int8" in jax.tree.leaves(engine)
+
+
+def test_quant_uncovered_message_preserved():
+    """The engine-raised message keeps the pre-port prefix callers and
+    tests match on."""
+    with pytest.raises(ValueError, match="no quantization rule"):
+        match_quant_rules(
+            ((r"other/kernel$", "int8"),),
+            {"mystery": {"kernel": jnp.ones((4, 4))}},
+        )
+
+
+# ---------------------------------------------------------------------------
+# 3. match_partition_rules — the placement adapter (ROADMAP item 4 seam)
+# ---------------------------------------------------------------------------
+
+#: A COVERING Llama rule set: the TP preset's projection placements
+#: plus explicit keep rules for every remaining leaf class — the
+#: uncovered→raise contract then proves nothing slipped through.
+_COVERING_RULES = TP_TRANSFORMER_RULES + (
+    (r"(embedding|scale|bias)$", P()),
+    (r"^(count|mu|nu)$", P()),  # bare optax counters at the tree root
+)
+
+
+def test_partition_rules_cover_params_and_opt_state(llama_params):
+    """One call covers the WHOLE TrainState payload: optimizer moment
+    trees mirror params, so kernel$-style rules address their leaves
+    at the opt_state/.../mu/... paths too."""
+    tx = optax.adamw(1e-3)
+    tree = {
+        "params": llama_params,
+        "opt_state": tx.init(llama_params),
+    }
+    specs = rules_engine.match_partition_rules(_COVERING_RULES, tree)
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    param_leaves = jax.tree.leaves(tree)
+    assert len(spec_leaves) == len(param_leaves)
+    assert all(isinstance(s, P) for s in spec_leaves)
+    # The projection placements fired — on params AND on the moments.
+    flat = {
+        rules_engine.path_str(p): s
+        for p, s in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
+    q_params = [
+        k for k in flat
+        if k.startswith("params/") and re.search(r"q_proj/kernel$", k)
+    ]
+    q_moments = [
+        k for k in flat
+        if k.startswith("opt_state/") and re.search(r"q_proj/kernel$", k)
+        and "/mu/" in k
+    ]
+    assert q_params and q_moments
+    for k in q_params + q_moments:
+        assert flat[k] == P("fsdp", "tp"), (k, flat[k])
+
+
+def test_partition_rules_uncovered_raises(llama_params):
+    """Dropping the keep rules makes the first uncovered multi-element
+    leaf (the embedding table — its path doesn't match the TP preset's
+    ``embedding/embedding$`` pattern) raise by name — coverage is
+    enforced, not defaulted."""
+    with pytest.raises(
+        ValueError, match=r"no partition rule.*embed_tokens"
+    ):
+        rules_engine.match_partition_rules(
+            TP_TRANSFORMER_RULES, llama_params
+        )
+
+
+def test_partition_rules_explicit_default_replicates():
+    tree = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    specs = rules_engine.match_partition_rules(
+        ((r"w$", P("fsdp", None)),), tree, default=P()
+    )
+    assert specs["w"] == P("fsdp", None)
+    assert specs["b"] == P()
+
+
+def test_partition_rules_scalars_replicate_without_rules():
+    """The SNIPPETS.md [2] scalar contract: 0-d and single-element
+    leaves replicate before any rule lookup."""
+    specs = rules_engine.match_partition_rules(
+        (), {"count": jnp.zeros(()), "one": jnp.ones((1,))}
+    )
+    assert specs == {"count": P(), "one": P()}
+
+
+def test_partition_rules_callable_spec_sees_shape(llama_params):
+    """Rank-dependent placement (the FSDP largest-dim idiom) works
+    through the adapter — and agrees with spec_for_path leaf by leaf,
+    so the legacy consumer and the adapter cannot drift."""
+    rules = FSDP_RULES + ((r".*", P()),)
+    specs = rules_engine.match_partition_rules(rules, llama_params)
+    flat_specs = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    flat_params = jax.tree_util.tree_flatten_with_path(llama_params)[0]
+    checked = 0
+    for (path, spec), (_, leaf) in zip(flat_specs, flat_params):
+        shape = jnp.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            assert spec == P()
+            continue
+        assert spec == spec_for_path(
+            rules_engine.path_str(path), rules, shape
+        )
+        checked += 1
+    assert checked > 10
+    # And at least one kernel actually landed a sharded dim.
+    assert any(
+        s != P()
+        for _, s in flat_specs
+    )

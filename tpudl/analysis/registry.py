@@ -126,6 +126,27 @@ _declare("TPUDL_CE_VOCAB_BLOCK", "int", None,
          "divisibility walk).",
          "tpudl.ops.cross_entropy")
 
+# --- training precision --------------------------------------------------
+_declare("TPUDL_TRAIN_PRECISION", "str", None,
+         "Mixed-precision training policy preset (f32 | bf16 | fp8): "
+         "narrows benchmarks/train_precision.py's default cell sweep "
+         "to f32 + that cell (via policy_from_env); unset = full "
+         "sweep / no policy.",
+         "tpudl.train.precision")
+_declare("TPUDL_FP8_AMAX_WINDOW", "int", 16,
+         "fp8 delayed-scaling amax-history ring length per tensor "
+         "site (larger = smoother scales, slower reaction to "
+         "distribution shift).",
+         "tpudl.ops.fp8_dot")
+_declare("TPUDL_LOSS_SCALE_INIT", "float", 32768.0,
+         "Dynamic loss-scale starting value (power of two; backs off "
+         "on nonfinite grads, grows back after a clean streak).",
+         "tpudl.train.precision")
+_declare("TPUDL_LOSS_SCALE_GROWTH_INTERVAL", "int", 2000,
+         "Consecutive finite steps before the dynamic loss scale "
+         "doubles (capped at 2^24).",
+         "tpudl.train.precision")
+
 # --- serving -------------------------------------------------------------
 _declare("TPUDL_SERVE_SLOTS", "int", 4,
          "Default decode slot count for ServeSession.from_model "

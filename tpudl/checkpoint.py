@@ -51,6 +51,11 @@ def _state_payload(state: TrainState) -> dict:
     }
     if state.batch_stats is not None:
         payload["batch_stats"] = state.batch_stats
+    if getattr(state, "precision", None) is not None:
+        # Mixed-precision policy state (tpudl.train.precision): loss
+        # scale + fp8 amax rings — without it a resume would restart
+        # the loss-scale schedule and re-warm every amax window.
+        payload["precision"] = state.precision
     return payload
 
 
@@ -134,11 +139,15 @@ def restore_train_state(
             payload = ckptr.restore(
                 path, _abstract_payload(state, mesh, rules)
             )
+    extra = {}
+    if hasattr(state, "precision"):
+        extra["precision"] = payload.get("precision", state.precision)
     return state.replace(
         params=payload["params"],
         opt_state=payload["opt_state"],
         step=payload["step"],
         batch_stats=payload.get("batch_stats", state.batch_stats),
+        **extra,
     )
 
 
@@ -388,11 +397,15 @@ class CheckpointManager:
             payload = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
+        extra = {}
+        if hasattr(state, "precision"):
+            extra["precision"] = payload.get("precision", state.precision)
         return state.replace(
             params=payload["params"],
             opt_state=payload["opt_state"],
             step=payload["step"],
             batch_stats=payload.get("batch_stats", state.batch_stats),
+            **extra,
         )
 
     def restore_full(

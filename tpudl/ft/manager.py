@@ -62,6 +62,11 @@ def state_payload(state: Any) -> dict:
     }
     if getattr(state, "batch_stats", None) is not None:
         payload["batch_stats"] = state.batch_stats
+    if getattr(state, "precision", None) is not None:
+        # Mixed-precision policy state (loss scale + fp8 amax rings):
+        # part of FULL resume — a restart must pick up the loss-scale
+        # schedule and delayed-scaling windows exactly where they were.
+        payload["precision"] = state.precision
     return payload
 
 
@@ -303,6 +308,9 @@ class AsyncCheckpointManager:
                 placed = [jnp.asarray(arrays[key]) for key, _ in template]
             treedef = jax.tree_util.tree_structure(payload)
             restored = jax.tree_util.tree_unflatten(treedef, placed)
+        extra = {}
+        if hasattr(state, "precision"):
+            extra["precision"] = restored.get("precision", state.precision)
         new_state = state.replace(
             params=restored["params"],
             opt_state=restored["opt_state"],
@@ -310,6 +318,7 @@ class AsyncCheckpointManager:
             batch_stats=restored.get(
                 "batch_stats", getattr(state, "batch_stats", None)
             ),
+            **extra,
         )
         rng = None
         if meta.get("rng") is not None:

@@ -83,6 +83,18 @@ class BertConfig:
     #: always stay full precision. Param-tree structure is identical
     #: in all modes.
     weight_dtype: Optional[str] = None
+    #: fp8 TRAINING tier (tpudl.ops.fp8_dot + the tpudl.train.precision
+    #: "fp8" policy): False (default) = nothing changes; True = the
+    #: SAME rule-class sites the quantizer addresses (encoder
+    #: attention + MLP projections — tpudl.quant BERT_QUANT_PATTERNS)
+    #: become Fp8Dense: e4m3 forward / e5m2 gradient matmuls with
+    #: delayed scaling, params still nn.Dense-identical f32 masters
+    #: (checkpoints interchange); the per-site amax rings live in the
+    #: "fp8" variable collection the train step threads through
+    #: TrainState.precision. "force"/"fused"/"reference" pin the
+    #: fp8_dot impl seam (CPU parity-test modes). Mutually exclusive
+    #: with weight_dtype (serving quantization of a frozen tree).
+    fp8_train: Any = False
 
     @property
     def head_dim(self) -> int:
@@ -99,9 +111,29 @@ BERT_LARGE = partial(BertConfig, hidden_size=1024, num_layers=24, num_heads=16,
 def _dense(cfg: BertConfig, features: int, name: str, quantize: bool = False):
     """Dense projection. ``quantize=True`` marks the encoder
     attention/MLP sites the ``weight_dtype`` seam swaps to QuantDense
-    (exactly the leaves tpudl.quant's BERT_QUANT_PATTERNS match);
-    pooler/classifier callers leave it False and always stay full
-    precision."""
+    (exactly the leaves tpudl.quant's BERT_QUANT_PATTERNS match) and
+    the ``fp8_train`` seam swaps to Fp8Dense — ONE rule-class set,
+    three precision tiers; pooler/classifier callers leave it False
+    and always stay full precision."""
+    if quantize and cfg.fp8_train:
+        if cfg.weight_dtype is not None:
+            raise ValueError(
+                "fp8_train (training-time fp8 matmuls) and weight_dtype "
+                "(serving quantization of a frozen tree) are mutually "
+                "exclusive — pick one"
+            )
+        from tpudl.ops.fp8_dot import Fp8Dense
+
+        impl = cfg.fp8_train if isinstance(cfg.fp8_train, str) else "auto"
+        if impl == "force":
+            impl = "fused"
+        return Fp8Dense(
+            features,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            impl=impl,
+            name=name,
+        )
     if quantize and cfg.weight_dtype is not None:
         from tpudl.quant.dense import QuantDense
 

@@ -70,6 +70,14 @@ class LlamaConfig:
     # Norms/embeddings/lm_head always stay full precision. Serving
     # entry: ServeSession.from_model(weight_dtype=...).
     weight_dtype: Optional[str] = None
+    # fp8 TRAINING tier (tpudl.ops.fp8_dot + the tpudl.train.precision
+    # "fp8" policy): True routes the SAME rule-class projection sites
+    # the quantizer addresses (LLAMA_QUANT_PATTERNS — the seven
+    # per-block projections) through Fp8Dense (e4m3 fwd / e5m2 grad,
+    # delayed scaling; f32 master params, nn.Dense-identical tree).
+    # "force"/"fused"/"reference" pin the fp8_dot impl seam. Mutually
+    # exclusive with weight_dtype and lora_rank.
+    fp8_train: Any = False
     # MoE (tpudl.ops.moe): >0 swaps the dense SwiGLU MLP for an
     # expert-parallel gated MoE in every block.
     moe_experts: int = 0
@@ -122,7 +130,30 @@ def _proj(cfg: LlamaConfig, features: int, name: str):
     on what the tree holds, exactly like QuantDense) with the adapters
     full precision on top — the QLoRA-style quantized-base fine-tune
     shape. Adapter leaves fall under the quantizer's keep-all rule, so
-    quantize_model on a LoRA tree quantizes only the base kernels."""
+    quantize_model on a LoRA tree quantizes only the base kernels.
+    ``fp8_train`` (training-time fp8 matmuls, tpudl.ops.fp8_dot) swaps
+    the same sites to Fp8Dense instead — exclusive with both serving
+    quantization and adapters."""
+    if cfg.fp8_train:
+        if cfg.weight_dtype is not None or cfg.lora_rank > 0:
+            raise ValueError(
+                "fp8_train (training-time fp8 matmuls) does not compose "
+                "with weight_dtype (frozen-tree serving quantization) "
+                "or lora_rank — pick one"
+            )
+        from tpudl.ops.fp8_dot import Fp8Dense
+
+        impl = cfg.fp8_train if isinstance(cfg.fp8_train, str) else "auto"
+        if impl == "force":
+            impl = "fused"
+        return Fp8Dense(
+            features,
+            use_bias=False,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            impl=impl,
+            name=name,
+        )
     if cfg.weight_dtype is not None and cfg.lora_rank == 0:
         from tpudl.quant.dense import QuantDense
 

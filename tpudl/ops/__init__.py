@@ -22,6 +22,10 @@ kernel tier, and expert-parallel MoE.
 - segmented_lora.py   — heterogeneous-adapter batched LoRA delta over
                         page pools (gather-from-pool in-kernel, f32
                         accumulation; the multi-tenant serving matmul);
+- fp8_dot.py          — fp8 TRAINING matmul (e4m3 fwd / e5m2 grad) with
+                        delayed scaling: per-tensor amax-history rings
+                        as traced state, saturate-don't-NaN casts,
+                        gradient amax via the g_probe cotangent;
 - moe.py              — top-k routed expert FFN over `ep` (all-to-all).
 """
 
@@ -62,6 +66,10 @@ from tpudl.ops.cross_entropy import (  # noqa: F401
 from tpudl.ops.segmented_lora import (  # noqa: F401
     segmented_lora,
     segmented_lora_ref,
+)
+from tpudl.ops.fp8_dot import (  # noqa: F401
+    Fp8Dense,
+    fp8_dot,
 )
 from tpudl.ops.moe import (  # noqa: F401
     EP_MOE_RULES,

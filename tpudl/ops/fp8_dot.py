@@ -1,0 +1,310 @@
+"""fp8 matmul with DELAYED SCALING for training: e4m3 forward, e5m2
+gradient.
+
+The training-side mirror of tpudl.quant (which quantizes *frozen*
+serving weights): here both matmul operands are cast to fp8 fresh each
+step, so the scale must track a *moving* tensor distribution without
+forcing a host sync or a recompile. Delayed scaling is the standard
+answer (Micikevicius et al., FP8 Formats for Deep Learning): each
+tensor site keeps a ring of the last ``window`` step amaxes, the
+quantization scale derives from the ring's max, and the CURRENT step's
+amax is recorded for the NEXT step's scale — scale computation is pure
+traced arithmetic over state carried in the TrainState, so scale
+updates never touch python and never recompile
+(tests/test_precision.py audits a multi-step run with
+``assert_no_recompiles``).
+
+Per-tensor scaling, three tensors per dot site:
+
+- ``x`` (activation) and ``w`` (weight): e4m3 — more mantissa, enough
+  range once scaled; forward product accumulates in f32 and the
+  dequant (one ``sx*sw`` multiply) fuses onto the output.
+- ``g`` (incoming gradient): e5m2 — gradients need the range; the
+  backward dots dequantize the same way.
+
+Saturation contract: values are clipped to the format's finite max
+BEFORE the cast (a bare ``astype`` to e4m3 maps overflow to NaN), so a
+step whose amax outgrew the window's scale produces a saturated-but-
+finite product, the true amax enters the history, and the next step's
+scale covers it. Nonfinite amaxes (an inf that slipped through from a
+diverging loss) never enter the ring — ``update_amax_history`` keeps
+the previous window max instead, and the loss-scale machinery
+(tpudl.train.precision) skips the step.
+
+The gradient amax rides OUT of the backward pass as the cotangent of a
+dummy scalar input (``g_probe``): the forward ignores it, the custom
+VJP writes ``max|g|`` as its "gradient", and the train step reads it
+from the same ``jax.grad`` call that produces the weight gradients —
+no side channel, no extra dispatch.
+
+``impl=`` seam (the tpudl.ops convention): ``"fused"`` feeds the
+native ``jnp.float8_*`` values straight into ``lax.dot_general``
+(storage dtype on the MXU — the bytes win), ``"reference"``
+dequantizes to f32 first and runs the plain dot (bit-comparable
+composite, the parity baseline), ``"auto"`` picks fused on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: Largest finite magnitudes of the two training formats.
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+#: Default amax-history ring length (TPUDL_FP8_AMAX_WINDOW overrides).
+DEFAULT_AMAX_WINDOW = 16
+
+
+def default_amax_window() -> int:
+    from tpudl.analysis.registry import env_int
+
+    return env_int("TPUDL_FP8_AMAX_WINDOW", DEFAULT_AMAX_WINDOW, min_value=1)
+
+
+def resolve_fp8_impl(impl: str) -> bool:
+    """``impl`` -> use_native (True = f8 values feed lax.dot_general).
+    Mirrors tpudl.ops.norms.resolve_impl: auto = fused on TPU,
+    reference off-TPU (the XLA CPU path runs either — tests pin both)."""
+    from tpudl.ops.attention import is_tpu_backend
+
+    if impl == "auto":
+        impl = "fused" if is_tpu_backend() else "reference"
+    if impl not in ("fused", "reference"):
+        raise ValueError(
+            f"impl must be 'auto', 'fused' or 'reference', got {impl!r}"
+        )
+    return impl == "fused"
+
+
+def amax_history_init(window: int) -> jax.Array:
+    """Fresh ring: all zeros => scale 1.0 until the first real amax
+    lands (see ``history_scale``)."""
+    return jnp.zeros((int(window),), jnp.float32)
+
+
+def update_amax_history(hist: jax.Array, amax: jax.Array) -> jax.Array:
+    """Ring insert: newest amax at slot 0, oldest falls off. A
+    nonfinite amax (diverged step) is replaced by the window's current
+    max so one bad step can't poison ``window`` future scales."""
+    amax = jnp.asarray(amax, jnp.float32)
+    amax = jnp.where(jnp.isfinite(amax), amax, jnp.max(hist))
+    return jnp.concatenate([amax[None], hist[:-1]])
+
+
+def history_scale(hist: jax.Array, dtype_max: float) -> jax.Array:
+    """Quantization scale from the ring: ``max(hist) / dtype_max`` maps
+    the window's largest observed magnitude onto the format's top; an
+    empty (all-zero) history scales by 1.0 — the first step quantizes
+    raw values, records the true amax, and the window takes over."""
+    amax = jnp.max(hist)
+    return jnp.where(amax > 0.0, amax / dtype_max, 1.0)
+
+
+def _cast_fp8(x: jax.Array, scale: jax.Array, dtype, dtype_max: float):
+    """Scale-then-cast with the saturation contract: clip to the finite
+    max first (astype alone maps overflow to NaN on e4m3)."""
+    scaled = jnp.asarray(x, jnp.float32) / scale
+    return jnp.clip(scaled, -dtype_max, dtype_max).astype(dtype)
+
+
+def _dot2d(a: jax.Array, b: jax.Array, native: bool) -> jax.Array:
+    """[M, K] @ [K, N] -> f32 [M, N]. ``native``: f8 operands feed the
+    dot directly (f32 accumulation via preferred_element_type);
+    reference dequantizes to f32 first — same math, composite operands."""
+    if not native:
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+    return lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.lru_cache(maxsize=2)
+def _make_fp8_dot(native: bool) -> Callable:
+    """Build the custom-VJP fp8 dot for one impl. Signature:
+
+        fp8_dot(x [..., K], w [K, N], x_hist, w_hist, g_hist, g_probe)
+            -> out [..., N]
+
+    Histories are data (traced), not parameters: their cotangents are
+    zero. ``g_probe`` (scalar 0.0) exists solely to carry ``max|g|``
+    out as its cotangent. The public ``fp8_dot`` wrapper below adds the
+    forward amaxes (plain stop-gradient reductions outside the VJP)."""
+
+    def _primal(x, w, x_hist, w_hist, g_hist, g_probe):
+        sx = history_scale(x_hist, E4M3_MAX)
+        sw = history_scale(w_hist, E4M3_MAX)
+        x2 = x.reshape(-1, x.shape[-1])
+        qx = _cast_fp8(x2, sx, jnp.float8_e4m3fn, E4M3_MAX)
+        qw = _cast_fp8(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+        out = _dot2d(qx, qw, native) * (sx * sw)
+        out = out.reshape(*x.shape[:-1], w.shape[-1]).astype(x.dtype)
+        # Residuals must be arrays: dtypes ride as zero-size carriers,
+        # and x's shape is recoverable from the cotangent's in _vjp_bwd.
+        res = (
+            qx, qw, sx, sw, g_hist,
+            jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype),
+        )
+        return out, res
+
+    @jax.custom_vjp
+    def fp8_dot(x, w, x_hist, w_hist, g_hist, g_probe):
+        return _primal(x, w, x_hist, w_hist, g_hist, g_probe)[0]
+
+    def _vjp_fwd(x, w, x_hist, w_hist, g_hist, g_probe):
+        return _primal(x, w, x_hist, w_hist, g_hist, g_probe)
+
+    def _vjp_bwd(res, g):
+        qx, qw, sx, sw, g_hist, x_proto, w_proto = res
+        x_shape = (*g.shape[:-1], qw.shape[0])
+        g2 = jnp.asarray(g, jnp.float32).reshape(-1, g.shape[-1])
+        sg = history_scale(g_hist, E5M2_MAX)
+        g_amax = jnp.max(jnp.abs(g2)).astype(jnp.float32)
+        qg = _cast_fp8(g2, sg, jnp.float8_e5m2, E5M2_MAX)
+        # dx = g @ w^T at (sg * sw); dw = x^T @ g at (sx * sg).
+        dx = _dot2d(qg, qw.T, native) * (sg * sw)
+        dw = _dot2d(qx.T, qg, native) * (sx * sg)
+        return (
+            dx.reshape(x_shape).astype(x_proto.dtype),
+            dw.astype(w_proto.dtype),
+            jnp.zeros_like(g_hist),  # x_hist: data, no gradient
+            jnp.zeros_like(g_hist),  # w_hist
+            jnp.zeros_like(g_hist),  # g_hist
+            g_amax,  # g_probe cotangent = the gradient-amax ride-out
+        )
+
+    fp8_dot.defvjp(_vjp_fwd, _vjp_bwd)
+    return fp8_dot
+
+
+def fp8_dot(
+    x: jax.Array,
+    w: jax.Array,
+    x_hist: jax.Array,
+    w_hist: jax.Array,
+    g_hist: jax.Array,
+    g_probe: jax.Array,
+    impl: str = "auto",
+):
+    """The site-level entry: quantized ``x @ w`` plus the step's
+    forward amaxes. Returns ``(out, x_amax, w_amax)``; the gradient
+    amax arrives as ``g_probe``'s cotangent (see module docstring)."""
+    native = resolve_fp8_impl(impl)
+    out = _make_fp8_dot(native)(x, w, x_hist, w_hist, g_hist, g_probe)
+    x_amax = jnp.max(jnp.abs(lax.stop_gradient(x))).astype(jnp.float32)
+    w_amax = jnp.max(jnp.abs(lax.stop_gradient(w))).astype(jnp.float32)
+    return out, x_amax, w_amax
+
+
+class Fp8Dense(nn.Module):
+    """Dense projection whose matmul runs through ``fp8_dot``.
+
+    Params are nn.Dense-identical (f32 master kernel/bias, same init),
+    so checkpoints interchange with the plain module — the tpudl.quant
+    QuantDense contract, applied to training. Per-site delayed-scaling
+    state lives in the ``"fp8"`` variable collection (three amax rings
+    + the gradient probe), created at ``model.init`` and carried in
+    ``TrainState.precision["fp8"]`` by the train step, which passes it
+    back in as a TRACED input every step — scale updates never
+    recompile. The step reads each site's new forward amaxes from the
+    ``"intermediates"`` sow (key ``fp8_fwd``) and the gradient amax
+    from the fp8 collection's cotangents.
+    """
+
+    features: int
+    dtype: Any = None
+    use_bias: bool = True
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+    amax_window: Optional[int] = None
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features)
+        )
+        bias = (
+            self.param("bias", self.bias_init, (self.features,))
+            if self.use_bias
+            else None
+        )
+        window = self.amax_window or default_amax_window()
+        x_hist = self.variable(
+            "fp8", "x_hist", lambda: amax_history_init(window)
+        )
+        w_hist = self.variable(
+            "fp8", "w_hist", lambda: amax_history_init(window)
+        )
+        g_hist = self.variable(
+            "fp8", "g_hist", lambda: amax_history_init(window)
+        )
+        g_probe = self.variable(
+            "fp8", "g_probe", lambda: jnp.zeros((), jnp.float32)
+        )
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype
+        )
+        out, x_amax, w_amax = fp8_dot(
+            x, kernel,
+            x_hist.value, w_hist.value, g_hist.value, g_probe.value,
+            impl=self.impl,
+        )
+        # The step rebuilds next step's rings from these (mutable
+        # "intermediates"; a read-only apply — eval, export — drops the
+        # sow and the rings simply don't advance).
+        self.sow(
+            "intermediates", "fp8_fwd",
+            {"x_amax": x_amax, "w_amax": w_amax},
+        )
+        if bias is not None:
+            out = out + bias
+        return out
+
+
+def is_fp8_site(entry: Any) -> bool:
+    """True for one site's slice of the ``"fp8"`` collection."""
+    return isinstance(entry, dict) and "x_hist" in entry and "g_probe" in entry
+
+
+def updated_fp8_state(
+    fp8_vars: Any, intermediates: Any, fp8_grads: Any, ok: jax.Array
+) -> Any:
+    """Next step's fp8 collection: every site's rings advanced with the
+    step's observed amaxes — forward amaxes from the site's
+    ``fp8_fwd`` sow, gradient amax from the site's ``g_probe``
+    cotangent. ``ok`` (the loss-scale finite flag) gates the whole
+    update: a skipped step advances nothing, so a divergence never
+    writes garbage into the windows."""
+
+    def walk(site, inter, grads):
+        if is_fp8_site(site):
+            sown = inter["fp8_fwd"]
+            if isinstance(sown, (tuple, list)):
+                sown = sown[0]
+            new = {
+                "x_hist": update_amax_history(
+                    site["x_hist"], sown["x_amax"]
+                ),
+                "w_hist": update_amax_history(
+                    site["w_hist"], sown["w_amax"]
+                ),
+                "g_hist": update_amax_history(
+                    site["g_hist"], grads["g_probe"]
+                ),
+                "g_probe": site["g_probe"],
+            }
+            return {
+                k: jnp.where(ok, new[k], site[k]) for k in site
+            }
+        return {k: walk(site[k], inter[k], grads[k]) for k in site}
+
+    return walk(dict(fp8_vars), dict(intermediates), dict(fp8_grads))

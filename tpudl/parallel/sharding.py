@@ -12,12 +12,13 @@ rule lists.
 from __future__ import annotations
 
 import contextlib
-import re
 import threading
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpudl import rules as rules_engine
 
 P = PartitionSpec
 
@@ -27,33 +28,25 @@ Rules = Sequence[Tuple[str, PartitionSpec]]
 #: Fully-replicated default.
 REPLICATED = P()
 
-
-def _path_str(path) -> str:
-    """'params/Dense_0/kernel'-style path string from a tree path."""
-    parts = []
-    for k in path:
-        if hasattr(k, "key"):
-            parts.append(str(k.key))
-        elif hasattr(k, "idx"):
-            parts.append(str(k.idx))
-        elif hasattr(k, "name"):
-            parts.append(str(k.name))
-        else:
-            parts.append(str(k))
-    return "/".join(parts)
+#: Canonical keypath -> "a/b/kernel" conversion now lives in the shared
+#: rules engine (tpudl.rules); kept under the historical name for the
+#: call sites (quant, tests) that import it from here.
+_path_str = rules_engine.path_str
 
 
 def spec_for_path(
     path: str, rules: Optional[Rules], shape: Sequence[int] = ()
 ) -> PartitionSpec:
-    """First matching rule wins. A rule's spec may be a PartitionSpec or a
-    callable ``shape -> PartitionSpec`` (for rank-dependent placement, e.g.
-    conv vs dense kernels under FSDP)."""
-    if rules:
-        for pattern, spec in rules:
-            if re.search(pattern, path):
-                return spec(shape) if callable(spec) else spec
-    return REPLICATED
+    """First matching rule wins (tpudl.rules.first_match — the shared
+    resolution primitive). A rule's spec may be a PartitionSpec or a
+    callable ``shape -> PartitionSpec`` (for rank-dependent placement,
+    e.g. conv vs dense kernels under FSDP). No match replicates — the
+    legacy default; ``tpudl.rules.match_partition_rules`` is the
+    coverage-checked adapter."""
+    spec = rules_engine.first_match(rules, path)
+    if spec is rules_engine.NO_MATCH:
+        return REPLICATED
+    return spec(shape) if callable(spec) else spec
 
 
 def _clamp_entries(mesh: Mesh, spec: PartitionSpec, shape) -> PartitionSpec:

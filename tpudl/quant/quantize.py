@@ -28,11 +28,12 @@ custom pytree registration.
 from __future__ import annotations
 
 import dataclasses
-import re
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from tpudl import rules as rules_engine
 
 #: Supported weight storage dtypes. ``int8``: symmetric [-127, 127]
 #: (4x smaller than f32, the headline serving mode). ``fp8_e4m3``:
@@ -128,36 +129,49 @@ def dequantize_leaf(leaf: dict, dtype=jnp.float32) -> jax.Array:
 
 
 def _path_str(path) -> str:
-    from tpudl.parallel.sharding import _path_str as ps
+    return rules_engine.path_str(path)
 
-    return ps(path)
+
+def _quant_special(name: str, leaf: Any):
+    """The quantizer's intrinsic per-leaf rule: leaves with ndim < 2
+    (biases, norm scales, scalars) and already-quantized dicts never
+    quantize regardless of rules — they annotate None without a rule
+    lookup (tpudl.rules.annotate ``special`` hook)."""
+    if is_quantized(leaf) or jnp.ndim(leaf) < 2:
+        return True, None
+    return False, None
 
 
 def _dtype_for(name: str, leaf: Any, rules: Rules) -> Optional[str]:
-    """First-match rule lookup for one leaf. Leaves with ndim < 2
-    (biases, norm scales, scalars) never quantize regardless of rules;
-    a >=2-D leaf no rule covers raises — an uncovered parameter is a
-    rule-set bug, not a default."""
-    if is_quantized(leaf) or jnp.ndim(leaf) < 2:
-        return None
-    for pattern, dtype in rules:
-        if re.search(pattern, name):
-            return dtype
-    raise ValueError(
-        f"no quantization rule matches parameter {name!r} — add an "
-        f"explicit (pattern, None) keep rule or a catch-all"
-    )
+    """First-match rule lookup for one leaf through the shared engine
+    (tpudl.rules.first_match — bitwise-identical resolution to the
+    pre-factoring private loop, tests/test_rules.py pins it). A >=2-D
+    leaf no rule covers raises — an uncovered parameter is a rule-set
+    bug, not a default."""
+    handled, annotation = _quant_special(name, leaf)
+    if handled:
+        return annotation
+    dtype = rules_engine.first_match(rules, name)
+    if dtype is rules_engine.NO_MATCH:
+        raise ValueError(
+            f"no quantization rule matches parameter {name!r} — add an "
+            f"explicit (pattern, None) keep rule or a catch-all"
+        )
+    return dtype
 
 
 def match_quant_rules(rules: Rules, params: Any) -> Any:
     """Pytree of weight-dtype-or-None per leaf by first-match regex
     over the leaf's ``module/submodule/kernel`` path (the SNIPPETS.md
-    [2] shape). Quantized dicts stay opaque to the walk (their two
-    arrays are one logical leaf), hence is_leaf on the marker."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: _dtype_for(_path_str(path), leaf, rules),
+    [2] shape, via tpudl.rules.annotate). Quantized dicts stay opaque
+    to the walk (their two arrays are one logical leaf), hence is_leaf
+    on the marker."""
+    return rules_engine.annotate(
+        rules,
         params,
+        special=_quant_special,
         is_leaf=is_quantized,
+        what="quantization rule",
     )
 
 

@@ -1,0 +1,162 @@
+"""One rules engine for precision and placement.
+
+Every per-leaf pytree annotation in tpudl — quantization dtypes
+(tpudl.quant), PartitionSpecs (tpudl.parallel.sharding), precision
+casts and optimizer-moment dtypes (tpudl.train.precision) — follows
+the same contract, the SNIPPETS.md [2] ``match_partition_rules``
+shape:
+
+- a rule list of ``(path_regex, value)`` pairs is matched against each
+  leaf's ``module/submodule/kernel`` path string with ``re.search``;
+- FIRST match wins;
+- an uncovered leaf is a rule-set bug, not a default — it raises,
+  naming the leaf, unless the adapter opts into an explicit default.
+
+This module is that machinery, factored out of tpudl/quant/quantize.py
+(ROADMAP item 4's first clause) so precision policy and placement
+policy are one regex-over-path contract instead of three private
+reimplementations that drift. The adapters below (``annotate``,
+``match_partition_rules``) cover the common shapes; consumers with
+extra per-leaf semantics (the quantizer's ndim<2 skip, the sharding
+engine's divisibility clamp) build on ``first_match``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+#: One rule: (regex searched — not fullmatched — against the leaf's
+#: "a/b/kernel" path string, annotation value). The value's meaning is
+#: the adapter's: a dtype name or None for the quantizer, a
+#: PartitionSpec (or shape -> PartitionSpec callable) for placement, a
+#: cast class for the precision policy.
+Rule = Tuple[str, Any]
+Rules = Sequence[Rule]
+
+
+class _NoMatch:
+    """Singleton sentinel: no rule covered the path (distinct from a
+    rule that matched with value ``None`` — None is a legal, common
+    annotation meaning "keep")."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - repr cosmetics
+        return "tpudl.rules.NO_MATCH"
+
+
+NO_MATCH = _NoMatch()
+
+
+def path_str(path) -> str:
+    """'params/Dense_0/kernel'-style path string from a jax tree path.
+
+    The one canonical keypath -> string conversion every rule consumer
+    shares (tpudl.parallel.sharding re-exports it as ``_path_str`` for
+    back-compat)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def first_match(rules: Optional[Rules], path: str) -> Any:
+    """Value of the first rule whose regex searches into ``path``, else
+    ``NO_MATCH``. The single resolution primitive — every adapter and
+    every ported consumer (quantizer, sharding, precision) resolves
+    through this function, so rule semantics cannot diverge."""
+    if rules:
+        for pattern, value in rules:
+            if re.search(pattern, path):
+                return value
+    return NO_MATCH
+
+
+def annotate(
+    rules: Optional[Rules],
+    tree: Any,
+    *,
+    special: Optional[Callable[[str, Any], Tuple[bool, Any]]] = None,
+    default: Any = NO_MATCH,
+    resolve: Optional[Callable[[Any, Any], Any]] = None,
+    is_leaf: Optional[Callable[[Any], bool]] = None,
+    what: str = "rule",
+) -> Any:
+    """Per-leaf annotation pytree for ``tree`` by first-match regex.
+
+    - ``special(path, leaf) -> (handled, annotation)`` short-circuits
+      rule lookup for leaves with intrinsic annotations (the
+      quantizer's "ndim < 2 never quantizes", placement's "scalars
+      replicate");
+    - ``resolve(value, leaf)`` post-processes a matched value against
+      the leaf (placement applies callable specs to the shape);
+    - an uncovered leaf raises ``ValueError`` naming it — pass an
+      explicit ``default`` to opt out (the legacy replicate-by-default
+      sharding contract);
+    - ``what`` names the rule family in the raise message.
+    """
+
+    def one(path, leaf):
+        name = path_str(path)
+        if special is not None:
+            handled, annotation = special(name, leaf)
+            if handled:
+                return annotation
+        value = first_match(rules, name)
+        if value is NO_MATCH:
+            if default is NO_MATCH:
+                raise ValueError(
+                    f"no {what} matches parameter {name!r} — add an "
+                    f"explicit (pattern, None) keep rule or a catch-all"
+                )
+            return default
+        return resolve(value, leaf) if resolve is not None else value
+
+    return jax.tree_util.tree_map_with_path(one, tree, is_leaf=is_leaf)
+
+
+def match_partition_rules(
+    rules: Optional[Rules], tree: Any, *, default: Any = NO_MATCH
+) -> Any:
+    """PartitionSpec pytree for ``tree`` (the SNIPPETS.md [2]
+    ``match_partition_rules`` shape): scalars and single-element leaves
+    replicate, a callable rule value is applied to the leaf's shape
+    (rank-dependent placement), first match wins, and an uncovered
+    multi-element leaf raises — pass ``default=PartitionSpec()`` for
+    the legacy replicate-by-default behavior.
+
+    Covers params AND optimizer state in one call: optax moment trees
+    mirror the param tree, so ``kernel$``-style rules match their
+    leaves at the ``opt_state/.../mu/...`` paths too (the ROADMAP
+    item-4 seam — tests/test_rules.py pins full TrainState coverage).
+    """
+    from jax.sharding import PartitionSpec
+
+    def special(name, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return True, PartitionSpec()
+        return False, None
+
+    def resolve(value, leaf):
+        return value(getattr(leaf, "shape", ())) if callable(value) else value
+
+    return annotate(
+        rules,
+        tree,
+        special=special,
+        default=default,
+        resolve=resolve,
+        what="partition rule",
+    )

@@ -15,6 +15,12 @@ from tpudl.train.loop import (  # noqa: F401
     pad_batch,
     resume_latest,
 )
+from tpudl.train.precision import (  # noqa: F401
+    LossScaleConfig,
+    PrecisionPolicy,
+    policy,
+    policy_from_env,
+)
 from tpudl.train.profiling import (  # noqa: F401
     format_summary,
     summarize_trace,

@@ -79,9 +79,15 @@ def microbatch(batch: dict, accum_steps: int) -> dict:
 
 
 class TrainState(train_state.TrainState):
-    """TrainState extended with BatchNorm running statistics."""
+    """TrainState extended with BatchNorm running statistics and the
+    mixed-precision policy state (``tpudl.train.precision``): loss
+    scale scalars + fp8 amax rings, carried as traced leaves so scale
+    updates never recompile and checkpoints resume schedule-identical.
+    ``None`` (the default) is the legacy no-policy state — zero new
+    leaves, checkpoints unchanged."""
 
     batch_stats: Any = None
+    precision: Any = None
 
 
 def create_train_state(
@@ -90,14 +96,30 @@ def create_train_state(
     sample_input: jax.Array,
     tx: optax.GradientTransformation,
     init_kwargs: Optional[dict] = None,
+    precision: "Any | str | None" = None,
 ) -> TrainState:
+    """``precision``: a ``tpudl.train.precision.PrecisionPolicy`` (or
+    preset name) — wraps ``tx`` with the policy's rule-selected moment
+    dtypes and seeds ``TrainState.precision`` (loss scale, and the
+    model's ``"fp8"`` amax collection when the policy routes matmuls
+    through fp8). None = exactly the pre-policy behavior."""
     if init_kwargs is None:
         init_kwargs = {"train": False}
     variables = model.init(rng, sample_input, **init_kwargs)
+    prec_state = None
+    if precision is not None:
+        from tpudl.train import precision as precision_mod
+
+        pol = precision_mod.resolve_policy(precision)
+        tx = precision_mod.apply_moment_rules(tx, pol)
+        prec_state = precision_mod.init_precision_state(
+            pol, variables.get("fp8")
+        )
     return TrainState.create(
         apply_fn=model.apply,
         params=variables["params"],
         batch_stats=variables.get("batch_stats"),
+        precision=prec_state,
         tx=tx,
     )
 
@@ -131,8 +153,28 @@ def make_classification_train_step(
     input_transform: Optional[Callable[[dict], dict]] = None,
     overlap_bucket_mb: Optional[float] = None,
     loss_impl: str = "reference",
+    precision: "Any | str | None" = None,
 ) -> Callable:
     """Train step for image/sequence classification models.
+
+    ``precision`` (a ``tpudl.train.precision.PrecisionPolicy`` or
+    preset name — None keeps the legacy path bit-identical) applies
+    the mixed-precision contract inside the step: rule-matched params
+    cast to the compute dtype INSIDE the loss function (f32 masters,
+    f32 grads), logits/loss reduce in f32, dynamic loss scaling (when
+    the policy carries it) multiplies the loss before the backward,
+    unscales the grads after, and a nonfinite gradient SKIPS the
+    optimizer update (params/opt-state/step and fp8 amax windows
+    untouched, scale backs off) — the skip is a traced select, one
+    compiled program. With ``use_fp8`` the model's Fp8Dense sites run
+    the delayed-scaling fp8 matmul: their amax rings ride
+    ``state.precision["fp8"]`` in, advance with the step's observed
+    amaxes (forward amaxes sown, gradient amax via the g_probe
+    cotangent), and ride out on the returned state. Reported metrics
+    gain ``loss_scale`` / ``grad_skipped`` when scaling is on; the
+    ``loss`` metric is always the UNSCALED loss. fp8 composes with
+    everything except gradient accumulation (``accum_steps > 1``
+    raises — the amax rings would need per-microbatch threading).
 
     ``loss_impl`` routes the cross-entropy through the
     tpudl.ops.cross_entropy dispatch seam ("reference" = the optax
@@ -183,6 +225,16 @@ def make_classification_train_step(
         input_keys = (input_keys,)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    from tpudl.train import precision as precision_mod
+
+    policy = precision_mod.resolve_policy(precision)
+    if policy is not None and policy.use_fp8 and accum_steps > 1:
+        raise ValueError(
+            "precision policy 'fp8' does not compose with gradient "
+            "accumulation yet (the per-site amax rings would need to "
+            "thread through the microbatch scan) — use accum_steps=1 "
+            "or the bf16 policy"
+        )
     # None = auto (env knob, else default-on-multi-shard); an explicit
     # 0 disables — mapped to 0 bytes, which accumulate() treats as off.
     overlap_bucket_bytes = (
@@ -203,18 +255,37 @@ def make_classification_train_step(
 
     def _grads_and_metrics(state, params, stats, batch, dropout_rng):
         """value_and_grad of one (micro)batch; returns (grads, metrics,
-        new_stats) with metrics as means over the (micro)batch."""
+        new_stats, prec_aux) with metrics as means over the
+        (micro)batch. ``prec_aux`` is None on the legacy path; under an
+        fp8 policy it carries the fp8-collection cotangents and the
+        sown forward amaxes the step needs to advance the rings."""
         if input_transform is not None:
             batch = input_transform(batch)
         inputs = tuple(batch[k] for k in input_keys)
+        prec = getattr(state, "precision", None) or {}
+        loss_scale = (
+            prec["loss_scale"]["scale"]
+            if policy is not None and policy.loss_scale is not None
+            else None
+        )
+        fp8_vars = (
+            prec.get("fp8")
+            if policy is not None and policy.use_fp8
+            else None
+        )
 
-        def loss_fn(params):
-            variables = {"params": params}
+        def loss_fn(params, fp8_vars=None):
+            run_params = (
+                policy.cast_params(params) if policy is not None else params
+            )
+            variables = {"params": run_params}
+            if fp8_vars is not None:
+                variables["fp8"] = fp8_vars
             mutable = []
             if stats is not None:
                 variables["batch_stats"] = stats
                 mutable.append("batch_stats")
-            if moe_aux_weight > 0.0:
+            if moe_aux_weight > 0.0 or fp8_vars is not None:
                 mutable.append("intermediates")
             if mutable:
                 outputs, mutated = state.apply_fn(
@@ -232,6 +303,12 @@ def make_classification_train_step(
                 )
                 mutated = {}
                 new_stats = None
+            if policy is not None:
+                # Reduce-dtype contract: logits (and therefore the
+                # loss reduction) leave the compute dtype before any
+                # mean — the bf16/fp8 forward never degrades the loss
+                # arithmetic itself.
+                outputs = outputs.astype(policy.reduce_dtype)
             loss = cross_entropy_loss(
                 outputs, batch[label_key], label_smoothing, impl=loss_impl
             )
@@ -239,23 +316,89 @@ def make_classification_train_step(
             if moe_aux_weight > 0.0:
                 aux = _sown_aux(mutated)
                 loss = loss + moe_aux_weight * aux
-            return loss, (outputs, new_stats, aux)
+            # Dynamic loss scaling: the OBJECTIVE is scaled (after any
+            # aux terms, so the whole backward sees one factor); the
+            # reported loss stays unscaled via the aux tuple.
+            objective = loss if loss_scale is None else loss * loss_scale
+            return objective, (loss, outputs, new_stats, aux, mutated)
 
-        (loss, (logits, new_stats, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(params)
+        if fp8_vars is not None:
+            (
+                (_, (loss, logits, new_stats, aux, mutated)),
+                (grads, fp8_grads),
+            ) = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
+                params, fp8_vars
+            )
+        else:
+            (
+                (_, (loss, logits, new_stats, aux, mutated)),
+                grads,
+            ) = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            fp8_grads = None
+        if loss_scale is not None:
+            # Unscale per (micro)batch — linear, so accumulation-order
+            # independent; a scaled overflow stays nonfinite through
+            # the division and trips the skip select.
+            grads = jax.tree.map(lambda g: g / loss_scale, grads)
         metrics = {
             "loss": loss,
             "accuracy": jnp.mean(jnp.argmax(logits, -1) == batch[label_key]),
         }
         if aux is not None:
             metrics["moe_aux"] = aux
-        return grads, metrics, new_stats
+        prec_aux = None
+        if fp8_vars is not None:
+            prec_aux = {
+                "fp8_grads": fp8_grads,
+                "intermediates": mutated.get("intermediates", {}),
+            }
+        return grads, metrics, new_stats, prec_aux
+
+    def _finish_policy_step(state, grads, metrics, new_stats, prec_aux):
+        """Optimizer apply under a precision policy: the skip-on-
+        nonfinite select, the loss-scale transition, and the fp8 ring
+        advance — all traced (one compiled program; a skipped step is
+        a select, not a cond)."""
+        prec = state.precision or {}
+        applied = state.apply_gradients(grads=grads)
+        if new_stats is not None:
+            applied = applied.replace(batch_stats=new_stats)
+        if policy.loss_scale is not None:
+            ok = precision_mod.all_finite(grads)
+            # Skip = the whole state transition never happened: params,
+            # opt state, step counter, batch stats all keep their old
+            # values (precision state is replaced below either way).
+            new_state = precision_mod.select_tree(ok, applied, state)
+        else:
+            ok = jnp.asarray(True)
+            new_state = applied
+        new_prec = dict(prec)
+        metrics = dict(metrics)
+        if policy.loss_scale is not None:
+            # Report the scale the step USED (pre-transition) so logs
+            # line up with the backward that just ran.
+            metrics["loss_scale"] = prec["loss_scale"]["scale"]
+            metrics["grad_skipped"] = jnp.where(ok, 0.0, 1.0)
+            new_prec["loss_scale"] = precision_mod.update_loss_scale(
+                prec["loss_scale"], policy.loss_scale, ok
+            )
+        if policy.use_fp8 and prec_aux is not None:
+            from tpudl.ops.fp8_dot import updated_fp8_state
+
+            new_prec["fp8"] = updated_fp8_state(
+                prec["fp8"],
+                prec_aux["intermediates"],
+                prec_aux["fp8_grads"],
+                ok,
+            )
+        if new_prec:
+            new_state = new_state.replace(precision=new_prec)
+        return new_state, metrics
 
     def step(state: TrainState, batch: dict, rng: jax.Array):
         step_rng = jax.random.fold_in(rng, state.step)
         if accum_steps == 1:
-            grads, metrics, new_stats = _grads_and_metrics(
+            grads, metrics, new_stats, prec_aux = _grads_and_metrics(
                 state, state.params, state.batch_stats, batch, step_rng
             )
         else:
@@ -264,7 +407,7 @@ def make_classification_train_step(
             def body(carry, xs):
                 grads_acc, stats, metrics_acc = carry
                 mb, a = xs
-                grads, metrics, new_stats = _grads_and_metrics(
+                grads, metrics, new_stats, _ = _grads_and_metrics(
                     state, state.params, stats,
                     mb, jax.random.fold_in(step_rng, a),
                 )
@@ -281,7 +424,7 @@ def make_classification_train_step(
             # executing. BatchNorm stats thread through the carry,
             # updating per microbatch sequentially.
             mb0 = {k: v[0] for k, v in micro.items()}
-            _, m_shape, _ = jax.eval_shape(
+            _, m_shape, _, _ = jax.eval_shape(
                 lambda s, b, r: _grads_and_metrics(
                     state, state.params, s, b, r
                 ),
@@ -302,6 +445,11 @@ def make_classification_train_step(
             # metrics divide by the microbatch count.
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
+            prec_aux = None
+        if policy is not None:
+            return _finish_policy_step(
+                state, grads, metrics, new_stats, prec_aux
+            )
         new_state = state.apply_gradients(grads=grads)
         if new_stats is not None:
             new_state = new_state.replace(batch_stats=new_stats)
@@ -337,6 +485,13 @@ def make_classification_eval_step(
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
+        prec = getattr(state, "precision", None)
+        if prec and "fp8" in prec:
+            # fp8-trained models (Fp8Dense sites) read their amax rings
+            # at apply time; eval quantizes with the trained scales —
+            # the same numerics the train forward saw. Read-only: the
+            # sow is dropped, the rings don't advance.
+            variables["fp8"] = prec["fp8"]
         logits = state.apply_fn(
             variables, *(batch[k] for k in input_keys), train=False
         )
@@ -404,8 +559,20 @@ def compile_step(
     has_rng: bool = True,
     preprocess: Optional[Callable[[dict], dict]] = None,
     steps_per_dispatch: int = 1,
+    precision: "Any | str | None" = None,
 ) -> Callable:
     """jit a (state, batch[, rng]) step with mesh shardings.
+
+    ``precision``: the ``tpudl.train.precision.PrecisionPolicy`` (or
+    preset name) the step was built with — compile_step validates the
+    state actually carries the policy's traced pieces (loss-scale
+    scalars, fp8 amax rings) so a state built without
+    ``create_train_state(precision=...)`` fails HERE with a named
+    error instead of silently training unscaled, and exposes it as
+    ``wrapped.precision`` for drivers/benchmarks. The policy's dtype
+    work itself lives inside the step function
+    (``make_classification_train_step(precision=...)``); the new state
+    leaves shard replicated like any scalar under the rule engine.
 
     - state (params / opt state / batch stats) sharded by `rules`
       (replicated for pure DP, fsdp/tp specs for sharded training);
@@ -454,6 +621,12 @@ def compile_step(
             "steps_per_dispatch > 1 requires a train-shaped step "
             "(has_rng=True): eval steps return no carried state to scan"
         )
+    precision_policy = None
+    if precision is not None:
+        from tpudl.train import precision as precision_mod
+
+        precision_policy = precision_mod.resolve_policy(precision)
+        precision_mod.validate_state(precision_policy, state)
     if preprocess is not None:
         base_fn = step_fn
         if has_rng:
@@ -643,6 +816,7 @@ def compile_step(
     wrapped._tpudl_mask_aware = getattr(step_fn, "_tpudl_mask_aware", False)
     wrapped._tpudl_compile_pending = True
     wrapped.steps_per_dispatch = steps_per_dispatch
+    wrapped.precision = precision_policy
 
     if jitted_window is not None:
 
