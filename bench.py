@@ -537,6 +537,18 @@ def _bench_chaos():
     return measure_chaos()
 
 
+def _bench_requestlog():
+    """Durable request-log tier (tpudl.obs.requestlog via
+    benchmarks/serve_load.py): p99 TTFT with logging on vs off under
+    the closed-loop serve mix (the never-blocks-the-decode-loop claim,
+    measured) and on-disk bytes per logged request, with the
+    rotation + per-tenant reconciliation round-trip asserted on the
+    way. Banked from r16 onward (lower is better for both)."""
+    from benchmarks.serve_load import measure_requestlog
+
+    return measure_requestlog()
+
+
 def _bench_ft():
     """Fault-tolerance costs (benchmarks/ft_recovery.py): the async
     checkpoint's on-step stall and the kill-to-first-post-restart-step
@@ -707,6 +719,15 @@ def main(argv=None):
         print("serve chaos bench failed:", file=sys.stderr)
         traceback.print_exc()
         chaos_tier = {}
+    try:
+        rlog = _bench_requestlog()
+    except Exception:
+        import sys
+        import traceback
+
+        print("request-log bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        rlog = {}
     try:
         ft = _bench_ft()
     except Exception:
@@ -911,6 +932,18 @@ def main(argv=None):
         "serve_drain_p99_ms": chaos_tier.get("serve_drain_p99_ms"),
         "failover_token_gap_ms": chaos_tier.get(
             "failover_token_gap_ms"
+        ),
+        # Durable request log (tpudl.obs.requestlog via benchmarks/
+        # serve_load.py): p99 TTFT with the log enabled over the same
+        # closed-loop mix with it disabled (the bounded-queue writer's
+        # never-blocks-the-decode-loop claim, measured), and on-disk
+        # bytes per logged request (rotation + per-tenant token
+        # reconciliation asserted inside the benchmark).
+        "requestlog_overhead_p99_ttft_ratio": rlog.get(
+            "requestlog_overhead_p99_ttft_ratio"
+        ),
+        "requestlog_bytes_per_request": rlog.get(
+            "requestlog_bytes_per_request"
         ),
         # Fault tolerance (tpudl.ft via benchmarks/
         # ft_recovery.py): the async checkpoint's mean on-step

@@ -75,6 +75,8 @@ round.
 
 from __future__ import annotations
 
+import os
+import tempfile
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -1877,6 +1879,123 @@ def measure_serve(n_requests: int = 16, num_slots: int = 4) -> dict:
     }
 
 
+def run_requestlog_roundtrip(
+    log_dir: Optional[str] = None,
+    n_tenants: int = 4,
+    per_tenant: int = 4,
+    num_slots: int = 4,
+    sim_step_ms: float = 1.0,
+    seed: int = 0,
+    segment_bytes: int = 2048,
+    check: bool = True,
+) -> dict:
+    """The durable-log acceptance: a multi-tenant serve run with the
+    request log enabled (segment size forced small so the run CROSSES
+    a rotation boundary), then a full reader round-trip asserting the
+    log is a lossless account of the run — one record per Result, zero
+    drops, and per-tenant token rollups from the reader EQUAL the sums
+    over the live ``Result``s. This is the reconciliation bar the
+    flywheel ingest (and every per-tenant bill) stands on."""
+    from tpudl.obs import requestlog
+
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="tpudl-requestlog-")
+    adapters = make_adapters(n_tenants, rank=2, seed=seed)
+    session, _, _ = build_tenant_session(
+        adapters, num_slots=num_slots, sim_step_ms=sim_step_ms,
+    )
+    reqs = make_tenant_requests(
+        list(adapters), per_tenant, seed=seed + 1, tag="rlog"
+    )
+    writer = requestlog.enable(log_dir, segment_bytes=segment_bytes)
+    try:
+        results = session.serve(reqs)
+    finally:
+        requestlog.disable()  # commits the open segment
+
+    expected: Dict[str, int] = {}
+    for req in reqs:
+        expected[req.tenant] = expected.get(req.tenant, 0) + len(
+            results[req.request_id].tokens
+        )
+    records = [
+        r for r in requestlog.read_request_log(log_dir)
+        if str(r.get("request_id", "")).startswith("rlog-")
+    ]
+    got: Dict[str, int] = {}
+    for rec in records:
+        got[rec["tenant"]] = got.get(rec["tenant"], 0) + rec["tokens_out"]
+    out = {
+        "log_dir": log_dir,
+        "requests": len(reqs),
+        "records": len(records),
+        "segments": len(requestlog.list_segments(log_dir)),
+        "dropped": writer.dropped,
+        "per_tenant_tokens": got,
+        "reconciled": got == expected and len(records) == len(reqs),
+    }
+    if check:
+        assert writer.dropped == 0, f"{writer.dropped} records dropped"
+        assert out["segments"] >= 2, (
+            f"only {out['segments']} segment(s) — the round-trip must "
+            f"cross a rotation boundary (shrink segment_bytes)"
+        )
+        assert len(records) == len(reqs), (len(records), len(reqs))
+        assert got == expected, {"log": got, "results": expected}
+    return out
+
+
+def run_requestlog_overhead(
+    n_requests: int = 24, num_slots: int = 4, seed: int = 0
+) -> dict:
+    """Logging on vs off under the closed-loop serve mix: the p99 TTFT
+    ratio (the never-blocks-the-decode-loop claim, measured) and the
+    on-disk bytes per logged request. Fresh session per arm, each with
+    its own warmup, so neither side inherits the other's compilation."""
+    from tpudl.obs import requestlog
+
+    requestlog.disable()
+    session_off, _, _ = build_session(num_slots, continuous=True)
+    off = run_closed_loop(session_off, make_requests(n_requests, seed))
+
+    log_dir = tempfile.mkdtemp(prefix="tpudl-requestlog-bench-")
+    session_on, _, _ = build_session(num_slots, continuous=True)
+    writer = requestlog.enable(log_dir)
+    try:
+        on = run_closed_loop(session_on, make_requests(n_requests, seed))
+    finally:
+        requestlog.disable()
+    total_bytes = sum(
+        os.path.getsize(path)
+        for _, _, path in requestlog.list_segments(log_dir)
+    )
+    logged = max(1, on["completed"] + on["shed"])
+    return {
+        "requestlog_overhead_p99_ttft_ratio": round(
+            on["ttft"]["p99_ms"] / max(off["ttft"]["p99_ms"], 1e-9), 3
+        ),
+        "requestlog_bytes_per_request": round(total_bytes / logged, 1),
+        "requestlog_dropped": writer.dropped,
+        "logging_off": off,
+        "logging_on": on,
+    }
+
+
+def measure_requestlog() -> dict:
+    """The bench.py entry: request-log overhead + footprint, with the
+    rotation/reconciliation round-trip asserted on the way."""
+    run_requestlog_roundtrip()
+    overhead = run_requestlog_overhead()
+    return {
+        "requestlog_overhead_p99_ttft_ratio": overhead[
+            "requestlog_overhead_p99_ttft_ratio"
+        ],
+        "requestlog_bytes_per_request": overhead[
+            "requestlog_bytes_per_request"
+        ],
+    }
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -1952,6 +2071,14 @@ def main(argv=None) -> int:
         "a small value; the banked headline is 64)",
     )
     ap.add_argument(
+        "--requestlog", action="store_true",
+        help="run the durable request-log round-trip: multi-tenant "
+        "serve with the log enabled across a forced rotation "
+        "boundary, then assert the reader recovers one record per "
+        "Result with per-tenant token rollups equal to the live "
+        "Results (zero drops)",
+    )
+    ap.add_argument(
         "--autoscale", action="store_true",
         help="run the autoscale-recovery acceptance: 2x-capacity "
         "overload on a 2-replica fleet -> FleetMonitor reports burn "
@@ -1996,6 +2123,10 @@ def main(argv=None) -> int:
             n_tenants=args.tenants_adapters
         )
         out["tenant_isolation"] = run_tenant_isolation()
+    if args.requestlog:
+        out["requestlog_roundtrip"] = run_requestlog_roundtrip(
+            per_tenant=max(1, args.requests)
+        )
     if args.chaos:
         out["chaos"] = run_chaos()
     if args.autoscale:

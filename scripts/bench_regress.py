@@ -132,6 +132,14 @@ NOISE_BAND_FLOORS = {
     "train_fp8_bytes_ratio": 0.05,
     "train_precision_parity_cells": 0.01,
     "bert_base_mfu_bf16": 0.10,
+    # Durable request-log keys (benchmarks/serve_load.py, banked from
+    # r16). The overhead ratio is two p99 TTFT tails of the same
+    # scheduler-owned closed loop (writer thread adds a contender on
+    # 1 vCPU), so its band stays wide; bytes-per-request is compact-JSON
+    # record arithmetic over a fixed request mix — near-deterministic,
+    # drift means the schema or the mix changed.
+    "requestlog_overhead_p99_ttft_ratio": 0.50,
+    "requestlog_bytes_per_request": 0.08,
 }
 DEFAULT_BAND_FLOOR = 0.08
 
@@ -151,6 +159,8 @@ LOWER_IS_BETTER = {
     "serve_drain_p99_ms",
     "failover_token_gap_ms",
     "serve_tenant_isolation_p99_ratio",
+    "requestlog_overhead_p99_ttft_ratio",
+    "requestlog_bytes_per_request",
 }
 
 #: Lower-is-better metrics whose banked baseline is 0 and must STAY 0:

@@ -40,6 +40,10 @@ echo "== multi-tenant smoke (adapter pool + segmented-LoRA batched decode)"
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmarks.serve_load \
     --tenants --tenants-adapters 8 --requests 4 > /dev/null
 
+echo "== request-log smoke (durable JSONL round-trip + per-tenant token reconciliation)"
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmarks.serve_load \
+    --requestlog --requests 4 > /dev/null
+
 echo "== chaos smoke (serving fault injection: migration, failover, drains)"
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest tests/ -q -m 'chaos and not slow' \
     -p no:cacheprovider

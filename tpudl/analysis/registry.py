@@ -98,6 +98,20 @@ _declare("TPUDL_OBS_HEARTBEAT_STALE_S", "float", 60.0,
          "Heartbeat staleness floor for /healthz (the effective "
          "threshold is cadence-adaptive: max(floor, 5x last interval)).",
          "tpudl.obs.exporter")
+_declare("TPUDL_OBS_REQUEST_LOG", "path", None,
+         "Durable request-log output directory (crc-guarded rotated "
+         "JSONL segments, one record per terminal serve Result); "
+         "set = logging on.",
+         "tpudl.obs.requestlog")
+_declare("TPUDL_OBS_REQUEST_LOG_SEGMENT_BYTES", "int", 1_048_576,
+         "Request-log segment rotation threshold in bytes (each "
+         "rotation commits the segment with its crc32 in the name).",
+         "tpudl.obs.requestlog")
+_declare("TPUDL_OBS_REQUEST_LOG_QUEUE", "int", 1024,
+         "Request-log writer queue depth; overflow drops records "
+         "(counted in requestlog_records_dropped) instead of blocking "
+         "the decode loop.",
+         "tpudl.obs.requestlog")
 _declare("TPUDL_PROFILE_DIR", "path", None,
          "jax.profiler trace output directory for fit(profile=...).",
          "tpudl.train.loop")

@@ -104,3 +104,35 @@ class ResumableIterator:
                 continue
             self._offset += 1
             return batch
+
+
+def resumable_request_log(directory: str) -> ResumableIterator:
+    """A ``ResumableIterator`` over a durable request log
+    (``tpudl.obs.requestlog``): epoch = segment index, offset = records
+    consumed within the segment — so the flywheel ingest checkpoints
+    its log position with the SAME ``state()`` dict the data loader
+    checkpoints its batch position, and a ``RequestLogReader.state()``
+    seeks an iterator built here (and vice versa).
+
+    The segment set is snapshotted at construction; a live log that
+    grows new segments needs a fresh iterator seeked to the saved
+    position (exactly how an ingest poll loop consumes it)."""
+    from tpudl.obs import requestlog
+
+    segments = requestlog.list_segments(directory)
+    last = segments[-1][0] if segments else -1
+    by_idx = {idx: (crc, path) for idx, crc, path in segments}
+
+    def _segment(epoch: int) -> list:
+        hit = by_idx.get(epoch)
+        if hit is None:
+            # Segment indices can be sparse (operator-deleted or
+            # GC-reaped segments): an absent index is an empty epoch,
+            # not an error, so positions keep their meaning.
+            return []
+        crc, path = hit
+        return requestlog.segment_records(
+            path, crc, is_tail=(epoch == last)
+        )
+
+    return ResumableIterator(_segment, epochs=last + 1)

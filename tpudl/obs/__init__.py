@@ -36,6 +36,15 @@ declarative latency objectives with burn-rate alerting over it, and
 ``tpudl.obs.fleet`` aggregates N such processes into one labeled
 fleet view (merged ``/metrics``, health rollup, cross-process trace
 stitching) for the serve tier's autoscaler.
+
+The serve tier additionally persists one versioned record per terminal
+``Result`` into a durable crc-guarded request log
+(``tpudl.obs.requestlog``, enabled via ``TPUDL_OBS_REQUEST_LOG``) —
+the span stream dies with the process, the request log is the artifact
+the continual-learning flywheel ingests — and the same records feed
+the per-tenant metering plane (``tpudl.obs.metering``):
+tenant-labeled Prometheus series and the ``report.py --tenants``
+cost-attribution table.
 """
 
 from tpudl.obs.counters import (  # noqa: F401
@@ -65,6 +74,20 @@ from tpudl.obs.goodput import (  # noqa: F401
     classify,
     classify_by_process,
     format_goodput,
+)
+from tpudl.obs.metering import (  # noqa: F401
+    TenantMeter,
+    meter,
+    render_tenants,
+)
+from tpudl.obs.requestlog import (  # noqa: F401
+    SCHEMA_VERSION,
+    RequestLogCorruptError,
+    RequestLogReader,
+    RequestLogWriter,
+    build_record,
+    log_result,
+    read_request_log,
 )
 from tpudl.obs.report import (  # noqa: F401
     build_fleet_report,

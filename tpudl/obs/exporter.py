@@ -361,7 +361,16 @@ class ObsExporter:
         )
 
     def metrics_text(self) -> str:
-        return render_prometheus(self._reg().snapshot(), heartbeat_ages())
+        # Aggregate registry first, then the per-tenant metering plane
+        # (tenant-LABELED series, same exposition format) — one scrape
+        # carries both. Lazy import: metering renders THROUGH
+        # render_prometheus above, so a module-level import would be a
+        # cycle.
+        from tpudl.obs import metering
+
+        return render_prometheus(
+            self._reg().snapshot(), heartbeat_ages()
+        ) + metering.render_tenants()
 
     def health(self) -> dict:
         return health_snapshot()

@@ -1,0 +1,225 @@
+"""Per-tenant usage metering: who consumed which chips.
+
+PR 14 made serving multi-tenant (adapters, quotas, SLO classes) with
+zero per-tenant observability — quota sheds land in ONE aggregate
+counter and "which tenant ate the fleet" is unanswerable. This module
+is the metering plane: a process-wide ``TenantMeter`` fed one
+schema-v1 request-log record at a time (``tpudl.obs.requestlog``'s
+``log_result`` chokepoint — the SAME records the durable log
+persists, so the live meter and the offline cost table can never
+disagree about what happened), rolled up per tenant and rendered as
+tenant-LABELED Prometheus series via PR 10's
+``render_prometheus(labels=...)``:
+
+- ``serve_tenant_requests_total`` / ``serve_tenant_requests_completed``
+- ``serve_tenant_tokens_in_total`` / ``serve_tenant_tokens_total``
+  (tokens served)
+- ``serve_tenant_requests_shed_<reason>`` — sheds split by tenant AND
+  reason (the aggregate ``serve_requests_shed_*`` counters in the main
+  registry are untouched; labels carry provenance, names never do)
+- ``serve_tenant_kv_byte_seconds_total`` — KV footprint x residency,
+  the bytes-model cost numerator
+- ``serve_tenant_adapter_residency_seconds_total`` — wall time the
+  tenant's adapter held slot pins
+- ``serve_tenant_adapter_reloads_total`` — thrash attribution
+- ``serve_tenant_chip_seconds_total`` — slot-occupancy seconds
+- ``serve_tenant_quota_utilization`` — gauge fed by
+  ``Router.load_report()`` (inflight tokens / quota)
+
+The base model (tenant None) meters under ``tenant="_base"`` so the
+label set is total: every request lands in exactly one tenant series.
+
+``ObsExporter.metrics_text()`` appends ``render_tenants()`` to the
+aggregate exposition, so one scrape carries both planes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+#: Label value for requests with no tenant (the plain base model):
+#: metering must be total over requests, and an absent label would
+#: make per-tenant sums silently non-reconciling.
+BASE_TENANT = "_base"
+
+
+class _TenantUsage:
+    """Mutable rollup for one tenant (all fields cumulative)."""
+
+    __slots__ = (
+        "requests_total", "requests_completed", "tokens_in",
+        "tokens_out", "prefix_hit_tokens", "spec_proposed",
+        "spec_accepted", "kv_page_seconds", "kv_byte_seconds",
+        "adapter_reloads", "adapter_residency_s", "chip_seconds",
+        "migrations", "sheds", "quota_utilization",
+    )
+
+    def __init__(self):
+        self.requests_total = 0
+        self.requests_completed = 0
+        self.tokens_in = 0
+        self.tokens_out = 0
+        self.prefix_hit_tokens = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.kv_page_seconds = 0.0
+        self.kv_byte_seconds = 0.0
+        self.adapter_reloads = 0
+        self.adapter_residency_s = 0.0
+        self.chip_seconds = 0.0
+        self.migrations = 0
+        self.sheds: Dict[str, int] = {}
+        self.quota_utilization: Optional[float] = None
+
+
+class TenantMeter:
+    """Thread-safe per-tenant usage rollups over request-log records."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantUsage] = {}
+
+    def _usage(self, tenant: Optional[str]) -> _TenantUsage:
+        key = tenant if tenant is not None else BASE_TENANT
+        u = self._tenants.get(key)
+        if u is None:
+            u = self._tenants[key] = _TenantUsage()
+        return u
+
+    def ingest(self, record: dict) -> None:
+        """Fold one schema-v1 request-log record into its tenant's
+        rollup. Records are terminal (one per request), so
+        requests_total is exact."""
+        reason = record.get("finish_reason", "?")
+        with self._lock:
+            u = self._usage(record.get("tenant"))
+            u.requests_total += 1
+            u.tokens_in += int(record.get("tokens_in", 0) or 0)
+            u.tokens_out += int(record.get("tokens_out", 0) or 0)
+            u.prefix_hit_tokens += int(
+                record.get("prefix_hit_tokens", 0) or 0
+            )
+            u.spec_proposed += int(record.get("spec_proposed", 0) or 0)
+            u.spec_accepted += int(record.get("spec_accepted", 0) or 0)
+            u.kv_page_seconds += float(
+                record.get("kv_page_seconds", 0.0) or 0.0
+            )
+            u.kv_byte_seconds += float(
+                record.get("kv_byte_seconds", 0.0) or 0.0
+            )
+            u.adapter_reloads += int(
+                record.get("adapter_reloads", 0) or 0
+            )
+            u.migrations += int(record.get("migrations", 0) or 0)
+            active = float(record.get("active_s", 0.0) or 0.0)
+            u.chip_seconds += active
+            if record.get("tenant") is not None:
+                u.adapter_residency_s += active
+            if reason in ("eos", "length"):
+                u.requests_completed += 1
+            else:
+                # Every non-completion is a shed class (shed_*,
+                # failover_exhausted, failed: ...) — normalize the
+                # failed family to one bucket so label values stay a
+                # closed set.
+                key = "failed" if reason.startswith("failed") else reason
+                u.sheds[key] = u.sheds.get(key, 0) + 1
+
+    def set_quota_utilization(
+        self, tenant: Optional[str], utilization: float
+    ) -> None:
+        """Gauge hook for ``Router.load_report()``: inflight-token
+        quota utilization in [0, inf) (>1 = over-admitted burst)."""
+        with self._lock:
+            self._usage(tenant).quota_utilization = float(utilization)
+
+    def tenants(self) -> Dict[str, dict]:
+        """Plain-dict snapshot of every tenant's rollup (test +
+        report surface)."""
+        with self._lock:
+            out = {}
+            for t, u in self._tenants.items():
+                out[t] = {
+                    "requests_total": u.requests_total,
+                    "requests_completed": u.requests_completed,
+                    "tokens_in": u.tokens_in,
+                    "tokens_out": u.tokens_out,
+                    "prefix_hit_tokens": u.prefix_hit_tokens,
+                    "spec_proposed": u.spec_proposed,
+                    "spec_accepted": u.spec_accepted,
+                    "kv_page_seconds": u.kv_page_seconds,
+                    "kv_byte_seconds": u.kv_byte_seconds,
+                    "adapter_reloads": u.adapter_reloads,
+                    "adapter_residency_s": u.adapter_residency_s,
+                    "chip_seconds": u.chip_seconds,
+                    "migrations": u.migrations,
+                    "sheds": dict(u.sheds),
+                    "quota_utilization": u.quota_utilization,
+                }
+            return out
+
+    def render(self) -> str:
+        """Tenant-labeled Prometheus exposition: one
+        ``render_prometheus(labels={"tenant": t})`` block per tenant,
+        concatenated. Counter semantics hold (cumulative, monotone);
+        the label carries provenance so metric NAMES stay tenant-free."""
+        from tpudl.obs.exporter import render_prometheus
+
+        parts = []
+        snap = self.tenants()
+        for tenant in sorted(snap):
+            u = snap[tenant]
+            counters = {
+                "serve_tenant_requests_total": u["requests_total"],
+                "serve_tenant_requests_completed": (
+                    u["requests_completed"]
+                ),
+                "serve_tenant_tokens_in_total": u["tokens_in"],
+                "serve_tenant_tokens_total": u["tokens_out"],
+                "serve_tenant_prefix_hit_tokens_total": (
+                    u["prefix_hit_tokens"]
+                ),
+                "serve_tenant_kv_byte_seconds_total": (
+                    u["kv_byte_seconds"]
+                ),
+                "serve_tenant_adapter_residency_seconds_total": (
+                    u["adapter_residency_s"]
+                ),
+                "serve_tenant_adapter_reloads_total": (
+                    u["adapter_reloads"]
+                ),
+                "serve_tenant_chip_seconds_total": u["chip_seconds"],
+            }
+            for reason, n in sorted(u["sheds"].items()):
+                counters[f"serve_tenant_requests_{reason}"] = n
+            gauges = {}
+            if u["quota_utilization"] is not None:
+                gauges["serve_tenant_quota_utilization"] = (
+                    u["quota_utilization"]
+                )
+            parts.append(
+                render_prometheus(
+                    {"counters": counters, "gauges": gauges},
+                    labels={"tenant": tenant},
+                )
+            )
+        return "".join(parts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tenants.clear()
+
+
+_meter = TenantMeter()
+
+
+def meter() -> TenantMeter:
+    """The process-wide tenant meter (the ``registry()`` idiom)."""
+    return _meter
+
+
+def render_tenants() -> str:
+    """Module-level convenience the exporter appends to its aggregate
+    exposition."""
+    return _meter.render()

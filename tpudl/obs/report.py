@@ -66,12 +66,22 @@ def load_records(paths: Iterable[str]) -> List[dict]:
     """Load span records from JSONL files and/or directories (directories
     glob ``*.jsonl``, recursively — a distributor obs dir with a
     ``workers/`` subdir loads in one argument)."""
+    from tpudl.obs.requestlog import _parse_segment_name
+
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
             hits = sorted(
                 glob.glob(os.path.join(p, "**", "*.jsonl"), recursive=True)
             )
+            # Durable request-log segments (requests-*.jsonl) are a
+            # different artifact with a different schema: a run dir
+            # that nests its requestlog under the obs dir must not
+            # leak usage records into the span report.
+            hits = [
+                h for h in hits
+                if _parse_segment_name(os.path.basename(h)) is None
+            ]
             if not hits:
                 raise FileNotFoundError(f"no *.jsonl files under {p}")
             files.extend(hits)
@@ -933,6 +943,138 @@ def format_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def load_request_records(paths: Iterable[str]) -> List[dict]:
+    """Load durable request-log records (tpudl.obs.requestlog) from
+    directories: each path is a request-log directory itself or a run
+    directory holding a ``requestlog/`` subdir (the
+    TPUDL_OBS_REQUEST_LOG convention of pointing it next to
+    TPUDL_OBS_DIR)."""
+    from tpudl.obs import requestlog
+
+    records: List[dict] = []
+    for p in paths:
+        found = None
+        for d in (p, os.path.join(p, "requestlog")):
+            if os.path.isdir(d) and requestlog.list_segments(d):
+                found = d
+                break
+        if found is None:
+            raise FileNotFoundError(
+                f"no request-log segments (requests-*.jsonl) under {p}"
+            )
+        records.extend(requestlog.read_request_log(found))
+    return records
+
+
+def find_request_record(paths: Iterable[str], request_id) -> Optional[dict]:
+    """The durable terminal record for one request, or None — the
+    ``--request`` fallback when the span stream is gone. Matched by
+    string form too (CLI args are strings)."""
+    try:
+        records = load_request_records(paths)
+    except FileNotFoundError:
+        return None
+    for rec in records:
+        rid = rec.get("request_id")
+        if rid == request_id or str(rid) == str(request_id):
+            return rec
+    return None
+
+
+def build_tenant_report(records: Iterable[dict]) -> dict:
+    """Cost-attribution rollup over durable request-log records: one
+    row per tenant with request/token volumes, chip-seconds (slot
+    occupancy), KV byte-seconds (the bytes-model cost numerator), and
+    each tenant's share of total chip time. Reuses the live metering
+    plane's fold (``TenantMeter.ingest``) so the offline table and the
+    scraped ``serve_tenant_*`` series can never disagree."""
+    from tpudl.obs.metering import TenantMeter
+
+    m = TenantMeter()
+    n = 0
+    for rec in records:
+        m.ingest(rec)
+        n += 1
+    tenants = m.tenants()
+    total_chip = sum(u["chip_seconds"] for u in tenants.values())
+    for u in tenants.values():
+        u["chip_share"] = (
+            u["chip_seconds"] / total_chip if total_chip else 0.0
+        )
+    return {
+        "records": n,
+        "tenants": tenants,
+        "total_chip_seconds": total_chip,
+    }
+
+
+def format_tenant_report(report: dict) -> str:
+    lines = [
+        f"request-log records: {report['records']}  "
+        f"total chip-seconds: {report['total_chip_seconds']:.3f}",
+        "",
+        f"{'tenant':<16} {'req':>6} {'done':>6} {'shed':>6} "
+        f"{'tok_in':>8} {'tok_out':>8} {'chip_s':>10} "
+        f"{'kv_gb_s':>10} {'reloads':>8} {'share':>7}",
+    ]
+    for tenant in sorted(report["tenants"]):
+        u = report["tenants"][tenant]
+        shed = sum(u["sheds"].values())
+        lines.append(
+            f"{tenant:<16} {u['requests_total']:>6} "
+            f"{u['requests_completed']:>6} {shed:>6} "
+            f"{u['tokens_in']:>8} {u['tokens_out']:>8} "
+            f"{u['chip_seconds']:>10.3f} "
+            f"{u['kv_byte_seconds'] / 1e9:>10.4f} "
+            f"{u['adapter_reloads']:>8} {u['chip_share']:>6.1%}"
+        )
+    sheds: Dict[str, int] = {}
+    for u in report["tenants"].values():
+        for reason, count in u["sheds"].items():
+            sheds[reason] = sheds.get(reason, 0) + count
+    if sheds:
+        lines.append("")
+        lines.append(
+            "sheds by reason: " + " ".join(
+                f"{r}={n}" for r, n in sorted(sheds.items())
+            )
+        )
+    return "\n".join(lines)
+
+
+def format_request_record(rec: dict) -> str:
+    """Render one durable terminal record — the ``--request`` answer
+    when the span stream no longer exists (no per-hop timeline, but
+    the outcome, volumes, and latency aggregates survive)."""
+    lines = [
+        f"request {rec.get('request_id')!r} "
+        f"(durable record, schema v{rec.get('v')})",
+        f"  tenant={rec.get('tenant')} site={rec.get('site')} "
+        f"finish_reason={rec.get('finish_reason')}",
+        f"  tokens_in={rec.get('tokens_in')} "
+        f"tokens_out={rec.get('tokens_out')} "
+        f"prefix_hit={rec.get('prefix_hit_tokens')} "
+        f"spec={rec.get('spec_accepted')}/{rec.get('spec_proposed')}",
+    ]
+    qw, ttft, tpot = (
+        rec.get("queue_wait_s"), rec.get("ttft_s"), rec.get("tpot_s")
+    )
+    lines.append(
+        "  queue_wait={} ttft={} tpot={}".format(
+            f"{1e3 * qw:.1f}ms" if qw is not None else "-",
+            f"{1e3 * ttft:.1f}ms" if ttft is not None else "-",
+            f"{1e3 * tpot:.2f}ms" if tpot is not None else "-",
+        )
+    )
+    lines.append(
+        f"  kv_page_s={rec.get('kv_page_seconds', 0.0):.3f} "
+        f"kv_byte_s={rec.get('kv_byte_seconds', 0.0):.1f} "
+        f"adapter_reloads={rec.get('adapter_reloads')} "
+        f"migrations={rec.get('migrations')}"
+    )
+    return "\n".join(lines)
+
+
 def main(argv: Optional[list] = None) -> int:
     import argparse
 
@@ -963,8 +1105,50 @@ def main(argv: Optional[list] = None) -> int:
                     "streams: per-process record counts, request "
                     "outcomes, router hop latencies, failover/"
                     "autoscale activity, and partial-trace warnings")
+    ap.add_argument("--tenants", action="store_true",
+                    help="print the per-tenant cost-attribution table "
+                    "from durable request-log records (paths are "
+                    "request-log directories or run dirs holding a "
+                    "requestlog/ subdir) instead of the span report")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.tenants:
+        # The durable log, not the span stream: --tenants answers
+        # "who consumed which chips" after the serving processes (and
+        # their TPUDL_OBS_DIR streams) are gone.
+        try:
+            reqlog = load_request_records(args.paths)
+        except FileNotFoundError as e:
+            print(e)
+            return 1
+        tenant_report = build_tenant_report(reqlog)
+        print(
+            json.dumps(tenant_report)
+            if args.json else format_tenant_report(tenant_report)
+        )
+        return 0
+    if args.request is not None:
+        # Prefer the stitched span timeline; fall back to the durable
+        # terminal record when the span stream is gone (or never held
+        # this request) — the request log outlives TPUDL_OBS_DIR.
+        try:
+            records = load_records(args.paths)
+            tl = build_request_timeline(records, args.request)
+        except (KeyError, FileNotFoundError) as e:
+            rec = find_request_record(args.paths, args.request)
+            if rec is not None:
+                print(
+                    json.dumps(rec)
+                    if args.json else format_request_record(rec)
+                )
+                return 0
+            print(e.args[0] if e.args else str(e))
+            return 1
+        print(
+            json.dumps(tl) if args.json else format_request_timeline(tl)
+        )
+        return 0
 
     records = load_records(args.paths)
     if args.fleet:
@@ -976,16 +1160,6 @@ def main(argv: Optional[list] = None) -> int:
                 )
         print(
             json.dumps(fleet) if args.json else format_fleet_report(fleet)
-        )
-        return 0
-    if args.request is not None:
-        try:
-            tl = build_request_timeline(records, args.request)
-        except KeyError as e:
-            print(e.args[0])
-            return 1
-        print(
-            json.dumps(tl) if args.json else format_request_timeline(tl)
         )
         return 0
     report = build_report(

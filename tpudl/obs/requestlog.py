@@ -1,0 +1,550 @@
+"""Durable request/result log: the flywheel ingestion source.
+
+PR 6's per-request trace events live in the ephemeral span stream and
+die with ``TPUDL_OBS_DIR``; ROADMAP item 4 (the per-tenant continual-
+LoRA flywheel) needs served requests to OUTLIVE the serving process.
+This module is that durable log: one versioned-schema JSONL record per
+terminal ``Result`` — who (tenant), what (tokens in/out, prefix hits,
+speculation accepted/proposed), how much (KV page-seconds, adapter
+reloads), and how it ended (finish_reason incl. every shed class and
+``failover_exhausted``) — written into crc-guarded rotated segments
+with the ``ft/store.py`` commit-or-invisible idiom:
+
+- the ACTIVE segment is named ``requests-NNNNNN.open.jsonl`` — visibly
+  uncommitted, append-only, tolerated torn at the tail like a span
+  stream;
+- on rotation (size >= segment_bytes) or close, the file is fsynced,
+  its whole-payload crc32 is computed, and one atomic ``os.rename``
+  publishes it as ``requests-NNNNNN-<crc32:08x>.jsonl`` — a committed
+  segment either carries a verifiable crc in its NAME or does not
+  exist.
+
+The writer NEVER blocks the decode loop: ``log()`` is a bounded-queue
+``put_nowait`` feeding a background writer thread; overflow increments
+``requestlog_records_dropped`` (visible, accounted) instead of
+stalling a serving engine on disk latency.
+
+``read_request_log(dir)`` / ``RequestLogReader`` iterate segments in
+index order, verify each committed segment's crc, skip a truncated or
+corrupt TAIL loudly (``warnings.warn``) while recovering every intact
+record before the tear, and raise ``RequestLogCorruptError`` on
+non-tail corruption (silent data loss in the middle of the log is the
+one unforgivable outcome). The reader's ``state()``/``seek()`` speak
+the exact ``{"epoch": segment, "offset": record}`` contract of
+``tpudl.ft.data.ResumableIterator`` — the flywheel ingest resumes
+mid-log across restarts like a data loader resumes mid-epoch.
+
+Activation mirrors the span stream: set ``TPUDL_OBS_REQUEST_LOG=/path``
+(or call ``enable(path)``) and every Result site logs through
+``log_result``; disabled is one env lookup and nothing per request.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import queue
+import threading
+import time
+import warnings
+import zlib
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+from tpudl.analysis.registry import env_int, env_str
+from tpudl.obs.counters import registry
+
+#: Schema version stamped into every record as ``"v"``. The contract:
+#: consumers accept records with ``v <= SCHEMA_VERSION`` and IGNORE
+#: unknown fields; producers only ever ADD fields within a version and
+#: bump the version when a field's meaning changes or disappears.
+SCHEMA_VERSION = 1
+
+_PREFIX = "requests-"
+_OPEN_SUFFIX = ".open.jsonl"
+_COMMIT_SUFFIX = ".jsonl"
+
+DEFAULT_SEGMENT_BYTES = 1 << 20
+DEFAULT_QUEUE_DEPTH = 1024
+
+
+class RequestLogCorruptError(RuntimeError):
+    """A committed NON-TAIL segment failed its crc or carries a
+    malformed record: the middle of the durable log is damaged, which
+    no amount of tail tolerance excuses."""
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _parse_segment_name(name: str) -> Optional[Tuple[int, Optional[int]]]:
+    """``requests-000003-1a2b3c4d.jsonl`` -> (3, crc);
+    ``requests-000004.open.jsonl`` -> (4, None); anything else None."""
+    if not name.startswith(_PREFIX):
+        return None
+    body = name[len(_PREFIX):]
+    if body.endswith(_OPEN_SUFFIX):
+        idx = body[: -len(_OPEN_SUFFIX)]
+        if idx.isdigit():
+            return int(idx), None
+        return None
+    if body.endswith(_COMMIT_SUFFIX):
+        stem = body[: -len(_COMMIT_SUFFIX)]
+        if "-" not in stem:
+            return None
+        idx, _, crc = stem.rpartition("-")
+        if idx.isdigit() and len(crc) == 8:
+            try:
+                return int(idx), int(crc, 16)
+            except ValueError:
+                return None
+    return None
+
+
+def list_segments(directory: str) -> List[Tuple[int, Optional[int], str]]:
+    """Segments under ``directory`` as ``(index, crc_or_None, path)``
+    sorted by index. A committed and an open file with the same index
+    (a crash between rename and unlink cannot produce this — rename is
+    the same inode — but a confused operator can) resolves to the
+    COMMITTED one: it carries the verifiable name."""
+    by_idx: dict = {}
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        parsed = _parse_segment_name(name)
+        if parsed is None:
+            continue
+        idx, crc = parsed
+        prev = by_idx.get(idx)
+        if prev is None or (prev[0] is None and crc is not None):
+            by_idx[idx] = (crc, os.path.join(directory, name))
+    return [
+        (idx, crc, path)
+        for idx, (crc, path) in sorted(by_idx.items())
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+
+class RequestLogWriter:
+    """Bounded-queue background writer of crc-committed JSONL segments.
+
+    ``log(record)`` is the only hot-path method: a ``put_nowait`` that
+    on overflow increments ``self.dropped`` (and the
+    ``requestlog_records_dropped`` counter) and RETURNS — the decode
+    loop never waits on the log. The writer thread serializes, appends
+    to the ``.open`` segment, and rotates at ``segment_bytes`` via
+    fsync -> crc -> atomic rename -> dir fsync, so a committed segment
+    is verifiable by name and a crash leaves at worst one torn
+    ``.open`` tail the reader recovers loudly."""
+
+    def __init__(
+        self,
+        directory: str,
+        segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+        queue_depth: int = DEFAULT_QUEUE_DEPTH,
+        clock: Callable[[], float] = time.time,
+    ):
+        if segment_bytes < 1:
+            raise ValueError(
+                f"segment_bytes must be >= 1, got {segment_bytes}"
+            )
+        if queue_depth < 1:
+            raise ValueError(
+                f"queue_depth must be >= 1, got {queue_depth}"
+            )
+        self.directory = directory
+        self.segment_bytes = segment_bytes
+        self.clock = clock
+        os.makedirs(directory, exist_ok=True)
+        existing = list_segments(directory)
+        # Never append into a previous process's segments (its .open
+        # tail may be torn; its committed names are immutable): start
+        # past the highest index on disk.
+        self._index = (existing[-1][0] + 1) if existing else 0
+        self.dropped = 0
+        self.written = 0
+        self.segments_committed = 0
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        self._lock = threading.Lock()  # guards dropped on the hot path
+        self._file = None
+        self._bytes = 0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="tpudl-requestlog", daemon=True
+        )
+        self._thread.start()
+
+    # -- hot path ------------------------------------------------------
+
+    def log(self, record: dict) -> None:
+        """Enqueue one record; NEVER blocks. Overflow is counted, not
+        waited out."""
+        if self._closed:
+            return
+        try:
+            self._queue.put_nowait(record)
+        except queue.Full:
+            with self._lock:
+                self.dropped += 1
+            registry().counter("requestlog_records_dropped").inc()
+
+    # -- writer thread -------------------------------------------------
+
+    def _open_path(self) -> str:
+        return os.path.join(
+            self.directory, f"{_PREFIX}{self._index:06d}{_OPEN_SUFFIX}"
+        )
+
+    def _ensure_open(self):
+        if self._file is None:
+            self._file = open(self._open_path(), "ab")
+            self._bytes = 0
+        return self._file
+
+    def _write_one(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":")) + "\n"
+        data = line.encode("utf-8")
+        f = self._ensure_open()
+        f.write(data)
+        self._bytes += len(data)
+        self.written += 1
+        registry().counter("requestlog_records_written").inc()
+        if self._bytes >= self.segment_bytes:
+            self._commit_segment()
+
+    def _commit_segment(self) -> None:
+        """fsync -> crc -> atomic rename -> dir fsync: the segment is
+        either invisible (still ``.open``) or committed with its crc in
+        the name — the store's commit-or-invisible idiom, applied to an
+        append-only log."""
+        if self._file is None:
+            return
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._file.close()
+        self._file = None
+        open_path = self._open_path()
+        with open(open_path, "rb") as f:
+            crc = zlib.crc32(f.read()) & 0xFFFFFFFF
+        final = os.path.join(
+            self.directory,
+            f"{_PREFIX}{self._index:06d}-{crc:08x}{_COMMIT_SUFFIX}",
+        )
+        os.rename(open_path, final)
+        _fsync_dir(self.directory)
+        self.segments_committed += 1
+        registry().counter("requestlog_segments_committed").inc()
+        self._index += 1
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is _STOP:
+                    return
+                if item is _FLUSH_ONLY:
+                    if self._file is not None:
+                        self._file.flush()
+                    continue
+                self._write_one(item)
+            except Exception:
+                # A failing disk must not kill the writer thread (the
+                # queue would fill and every record would be dropped
+                # silently as "overflow"); count it distinctly.
+                registry().counter("requestlog_write_errors").inc()
+            finally:
+                self._queue.task_done()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def flush(self) -> None:
+        """Block until every already-enqueued record is on disk (still
+        possibly in the uncommitted ``.open`` segment)."""
+        if self._closed:
+            return
+        try:
+            self._queue.put(_FLUSH_ONLY, timeout=30.0)
+        except queue.Full:
+            pass
+        self._queue.join()
+
+    def close(self) -> None:
+        """Drain, commit the open segment, stop the thread. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.join()
+        self._queue.put(_STOP)
+        self._thread.join(timeout=30.0)
+        self._commit_segment()
+
+
+_STOP = object()
+_FLUSH_ONLY = object()
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+
+
+def segment_records(path: str, crc: Optional[int], is_tail: bool) -> list:
+    """Parse one segment. Committed segments verify the whole-payload
+    crc first; the TAIL segment (committed-but-damaged or ``.open``)
+    degrades to loud line-by-line recovery; non-tail damage raises."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    damaged = crc is not None and (zlib.crc32(blob) & 0xFFFFFFFF) != crc
+    if damaged and not is_tail:
+        raise RequestLogCorruptError(
+            f"request-log segment {path} failed its crc32 check "
+            f"(non-tail corruption — the durable log is damaged)"
+        )
+    records = []
+    lines = blob.split(b"\n")
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            if is_tail:
+                warnings.warn(
+                    f"request-log tail segment {path} is truncated at "
+                    f"record {len(records)}; recovered {len(records)} "
+                    f"intact record(s) and skipped the torn tail",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+                return records
+            raise RequestLogCorruptError(
+                f"request-log segment {path} holds a malformed record "
+                f"at line {i} (non-tail corruption)"
+            )
+    if damaged:
+        # Tail crc mismatch but every line parsed: a torn final WRITE
+        # inside a committed name should be impossible (commit fsyncs
+        # first) — surface it, keep the records.
+        warnings.warn(
+            f"request-log tail segment {path} failed its crc32 check "
+            f"but every record parsed; keeping {len(records)} record(s)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return records
+
+
+class RequestLogReader:
+    """Positioned iterator over a request-log directory.
+
+    ``state()`` -> ``{"epoch": <segment index>, "offset": <records
+    consumed in that segment>}`` and ``seek(state)`` restore it — the
+    exact contract of ``ft.data.ResumableIterator.state()``, so the
+    flywheel ingest checkpoints its log position next to its model
+    state and resumes without re-reading (or double-counting) a single
+    record."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._segments = list_segments(directory)
+        self._seg_pos = 0  # position within self._segments
+        self._offset = 0  # records consumed in the current segment
+        self._records: Optional[list] = None
+
+    def state(self) -> dict:
+        if self._seg_pos < len(self._segments):
+            epoch = self._segments[self._seg_pos][0]
+        else:
+            epoch = (
+                self._segments[-1][0] + 1 if self._segments else 0
+            )
+        return {"epoch": epoch, "offset": self._offset}
+
+    def seek(self, state: dict) -> None:
+        epoch = int(state["epoch"])
+        offset = int(state["offset"])
+        self._seg_pos = len(self._segments)
+        for i, (idx, _, _) in enumerate(self._segments):
+            if idx >= epoch:
+                self._seg_pos = i
+                break
+        self._offset = offset if (
+            self._seg_pos < len(self._segments)
+            and self._segments[self._seg_pos][0] == epoch
+        ) else 0
+        self._records = None
+
+    def _load(self) -> Optional[list]:
+        if self._seg_pos >= len(self._segments):
+            return None
+        if self._records is None:
+            _, crc, path = self._segments[self._seg_pos]
+            is_tail = self._seg_pos == len(self._segments) - 1
+            self._records = segment_records(path, crc, is_tail)
+        return self._records
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        while True:
+            records = self._load()
+            if records is None:
+                raise StopIteration
+            if self._offset < len(records):
+                rec = records[self._offset]
+                self._offset += 1
+                return rec
+            self._seg_pos += 1
+            self._offset = 0
+            self._records = None
+
+
+def read_request_log(directory: str) -> Iterator[dict]:
+    """Iterate every recoverable record in ``directory`` in segment
+    order: committed segments crc-verified, a truncated/corrupt tail
+    skipped with a loud warning, non-tail corruption raised as
+    ``RequestLogCorruptError``."""
+    return RequestLogReader(directory)
+
+
+# ---------------------------------------------------------------------------
+# Record construction + the module-level active writer
+# ---------------------------------------------------------------------------
+
+
+def build_record(
+    request_id: Any,
+    finish_reason: str,
+    *,
+    tenant: Optional[str] = None,
+    site: str = "engine",
+    tokens_in: int = 0,
+    tokens_out: int = 0,
+    prefix_hit_tokens: int = 0,
+    spec_proposed: int = 0,
+    spec_accepted: int = 0,
+    kv_page_seconds: float = 0.0,
+    kv_byte_seconds: float = 0.0,
+    adapter_reloads: int = 0,
+    migrations: int = 0,
+    queue_wait_s: Optional[float] = None,
+    ttft_s: Optional[float] = None,
+    tpot_s: Optional[float] = None,
+    active_s: float = 0.0,
+    ts: Optional[float] = None,
+) -> dict:
+    """One schema-v1 record. ``active_s`` is the slot-occupancy wall
+    time (seat -> last token): the chip-seconds numerator of the
+    cost-attribution table and, for tenant-ful requests, the adapter
+    residency."""
+    return {
+        "v": SCHEMA_VERSION,
+        "ts": time.time() if ts is None else ts,
+        "request_id": request_id,
+        "tenant": tenant,
+        "finish_reason": finish_reason,
+        "site": site,
+        "tokens_in": int(tokens_in),
+        "tokens_out": int(tokens_out),
+        "prefix_hit_tokens": int(prefix_hit_tokens),
+        "spec_proposed": int(spec_proposed),
+        "spec_accepted": int(spec_accepted),
+        "kv_page_seconds": float(kv_page_seconds),
+        "kv_byte_seconds": float(kv_byte_seconds),
+        "adapter_reloads": int(adapter_reloads),
+        "migrations": int(migrations),
+        "queue_wait_s": queue_wait_s,
+        "ttft_s": ttft_s,
+        "tpot_s": tpot_s,
+        "active_s": float(active_s),
+    }
+
+
+_active: Optional[RequestLogWriter] = None
+_atexit_registered = False
+
+
+def enable(
+    directory: str,
+    segment_bytes: Optional[int] = None,
+    queue_depth: Optional[int] = None,
+) -> RequestLogWriter:
+    """Activate the durable log into ``directory``. Idempotent-ish:
+    re-enabling closes (commits) the previous writer first."""
+    global _active, _atexit_registered
+    if _active is not None:
+        _active.close()
+    _active = RequestLogWriter(
+        directory,
+        segment_bytes=(
+            segment_bytes
+            if segment_bytes is not None
+            else env_int(
+                "TPUDL_OBS_REQUEST_LOG_SEGMENT_BYTES",
+                DEFAULT_SEGMENT_BYTES,
+            )
+        ),
+        queue_depth=(
+            queue_depth
+            if queue_depth is not None
+            else env_int(
+                "TPUDL_OBS_REQUEST_LOG_QUEUE", DEFAULT_QUEUE_DEPTH
+            )
+        ),
+    )
+    if not _atexit_registered:
+        atexit.register(disable)
+        _atexit_registered = True
+    return _active
+
+
+def disable() -> None:
+    """Close (commit) and deactivate the writer. No-op when inactive."""
+    global _active
+    if _active is not None:
+        _active.close()
+        _active = None
+
+
+def active_writer() -> Optional[RequestLogWriter]:
+    """The active writer, auto-enabling from TPUDL_OBS_REQUEST_LOG on
+    first call (the span stream's TPUDL_OBS_DIR idiom) — None when
+    disabled, the free branch every Result site takes."""
+    if _active is not None:
+        return _active
+    log_dir = env_str("TPUDL_OBS_REQUEST_LOG")
+    if log_dir:
+        return enable(log_dir)
+    return None
+
+
+def log_result(record: dict) -> None:
+    """The single emission chokepoint every Result site calls: feed the
+    per-tenant meter (always — metering is in-memory and cheap), then
+    the durable log iff enabled."""
+    from tpudl.obs import metering
+
+    metering.meter().ingest(record)
+    w = active_writer()
+    if w is not None:
+        w.log(record)

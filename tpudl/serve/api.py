@@ -51,6 +51,7 @@ import numpy as np
 
 from tpudl.analysis.registry import env_flag, env_int, env_str
 from tpudl.obs import registry
+from tpudl.obs import requestlog
 from tpudl.obs.spans import active_recorder
 from tpudl.serve.cache import SlotCache
 from tpudl.serve.queue import CAT_SERVE_REQUEST, AdmissionQueue
@@ -665,6 +666,11 @@ class ServeSession:
                     finish_reason="shed_capacity", queue_wait_s=0.0,
                     num_tokens=0,
                 )
+            requestlog.log_result(requestlog.build_record(
+                rid, "shed_capacity", site="session",
+                tenant=request.tenant,
+                tokens_in=len(request.input_ids), queue_wait_s=0.0,
+            ))
         return rid
 
     def collect(self) -> Dict[Any, Result]:

@@ -67,6 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpudl.obs import registry
+from tpudl.obs import requestlog
 from tpudl.obs.spans import active_recorder
 from tpudl.serve.api import Request, Result
 from tpudl.serve.cache import (
@@ -151,6 +152,8 @@ class _Slot:
     __slots__ = (
         "entry", "request", "tokens", "position", "steps",
         "t_seated", "t_first", "t_last", "gap_origin",
+        "prefix_hit", "spec_proposed", "spec_accepted",
+        "adapter_reloads", "migrations",
     )
 
     def __init__(self, entry: _Entry, first_token: int, prompt_len: int,
@@ -167,6 +170,15 @@ class _Slot:
         # the first post-migration token lands (the failover token-gap
         # histogram — how long the client's stream actually stalled).
         self.gap_origin: Optional[float] = None
+        # Per-request usage accumulators for the terminal request-log
+        # record (tpudl.obs.requestlog): what the span stream scatters
+        # over prefill/decode events, gathered where the Result is
+        # built.
+        self.prefix_hit = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.adapter_reloads = 0
+        self.migrations = 0
 
 
 class _Migrated:
@@ -435,6 +447,11 @@ class Engine:
                     request_id=req.request_id, finish_reason=reason,
                     queue_wait_s=wait, num_tokens=0,
                 )
+            requestlog.log_result(requestlog.build_record(
+                req.request_id, reason, site="engine",
+                tenant=getattr(req, "tenant", None),
+                tokens_in=len(req.input_ids), queue_wait_s=wait,
+            ))
 
     def _seat(self, entry: _Entry, slot: int) -> None:
         """Prefill one request and scatter it into ``slot`` of the live
@@ -449,6 +466,7 @@ class Engine:
         lease = None
         hit = 0
         tenant_pinned = False
+        reloads0 = 0
         row_offset = self.prompt_len - int(ids.shape[0])
         try:
             if self.adapter_pool is not None:
@@ -456,6 +474,7 @@ class Engine:
                 # dispatch (loading them on demand — an evicted
                 # tenant's next request reloads transparently here);
                 # the pin transfers to the slot at bind time.
+                reloads0 = self.adapter_pool.num_reloads
                 arow, ascale = self.adapter_pool.acquire(req.tenant)
                 tenant_pinned = req.tenant is not None
             if self.prefix_share:
@@ -519,7 +538,12 @@ class Engine:
         registry().counter("serve_prefills").inc()
         self._install(entry, slot, row_cache, first, ids.shape[0], t0, now,
                       lease=lease, row_offset=row_offset,
-                      tenant_pinned=self.adapter_pool is not None)
+                      tenant_pinned=self.adapter_pool is not None,
+                      prefix_hit=hit,
+                      adapter_reloads=(
+                          self.adapter_pool.num_reloads - reloads0
+                          if self.adapter_pool is not None else 0
+                      ))
 
     def _seat_prefilled(self, item: _Prefilled, slot: int) -> None:
         """Seat a request a DEDICATED prefill replica already prefilled
@@ -533,7 +557,8 @@ class Engine:
     def _install(self, entry: _Entry, slot: int, row_cache: Any,
                  first: int, ids_len: int, t_popped: float,
                  t_first: float, lease=None, row_offset: Optional[int] = None,
-                 tenant_pinned: bool = False,
+                 tenant_pinned: bool = False, prefix_hit: int = 0,
+                 adapter_reloads: int = 0,
                  ) -> None:
         """Shared seat tail: cache insertion (dense scatter, paged
         reservation+scatter, or radix-shared left-aligned seat),
@@ -594,7 +619,10 @@ class Engine:
         reg.histogram("serve_ttft_ms").observe(ttft_ms)
         self._slo_observe("serve_queue_wait_ms", queue_wait_ms)
         self._slo_observe("serve_ttft_ms", ttft_ms)
-        self._slots[slot] = _Slot(entry, first, ids_len, t_popped, t_first)
+        s = _Slot(entry, first, ids_len, t_popped, t_first)
+        s.prefix_hit = prefix_hit
+        s.adapter_reloads = adapter_reloads
+        self._slots[slot] = s
         if self.on_token is not None:
             self.on_token(req.request_id, first)
         # A request can finish on its very first token.
@@ -979,6 +1007,11 @@ class Engine:
         s.steps = int(meta["steps"])
         s.t_last = float(meta["t_last"])
         s.gap_origin = float(meta["t_last"])
+        # The terminal record counts hops: each install is one
+        # migration survived (the source engine's accumulators do not
+        # ride the payload — usage before the move was already metered
+        # on the source's spans).
+        s.migrations = int(meta.get("migrations", 0)) + 1
         self._slots[slot] = s
         registry().counter("serve_migrations_installed").inc()
         registry().gauge("serve_slots_busy").set(
@@ -1016,6 +1049,10 @@ class Engine:
                 error=f"{type(exc).__name__}: {exc}", num_tokens=0,
                 shed_by="migration",
             )
+        requestlog.log_result(requestlog.build_record(
+            rid, f"failed: {type(exc).__name__}: {exc}", site="engine",
+            migrations=1,
+        ))
 
     def _fits_migrated(self, meta: dict) -> bool:
         """Can this payload's reservation seat RIGHT NOW? The radix
@@ -1094,6 +1131,27 @@ class Engine:
                 ttft_s=ttft, tpot_s=tpot, queue_wait_s=queue_wait,
                 generation_s=s.t_last - s.t_first, num_tokens=n,
             )
+        # Terminal durable-log record: slot occupancy x KV footprint,
+        # computed BEFORE the free below releases the pages.
+        active_s = max(0.0, s.t_last - s.t_seated)
+        kv_page_s = kv_byte_s = 0.0
+        if self.paged:
+            pages = -(-int(self.cache.lens[slot]) // self.cache.page_size)
+            kv_page_s = pages * active_s
+            kv_byte_s = kv_page_s * (
+                self.cache.nbytes / max(1, self.cache.num_pages)
+            )
+        requestlog.log_result(requestlog.build_record(
+            req.request_id, reason, site="engine",
+            tenant=getattr(req, "tenant", None),
+            tokens_in=len(req.input_ids), tokens_out=n,
+            prefix_hit_tokens=s.prefix_hit,
+            spec_proposed=s.spec_proposed, spec_accepted=s.spec_accepted,
+            kv_page_seconds=kv_page_s, kv_byte_seconds=kv_byte_s,
+            adapter_reloads=s.adapter_reloads, migrations=s.migrations,
+            queue_wait_s=queue_wait, ttft_s=ttft, tpot_s=tpot,
+            active_s=active_s,
+        ))
         self.cache.free(slot)
         if self.speculator is not None:
             self.speculator.free(slot)
@@ -1277,6 +1335,8 @@ class Engine:
             s.position += n
             s.steps += n
             s.t_last = now
+            s.spec_proposed += k
+            s.spec_accepted += min(accepted, n)
             total_emitted += n
             total_accepted += min(accepted, n)
             slot_accepted.append(min(accepted, n))

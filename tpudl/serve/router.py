@@ -72,7 +72,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from tpudl.analysis.concurrency import maybe_wrap_locks
 from tpudl.analysis.registry import env_int
-from tpudl.obs import registry
+from tpudl.obs import metering, registry, requestlog
 from tpudl.obs.spans import active_recorder
 from tpudl.serve import chaos as serve_chaos
 from tpudl.serve.api import Request, Result, ServeSession, validate_request
@@ -469,6 +469,13 @@ class Replica:
                                     queue_wait_s=wait, num_tokens=0,
                                     shed_by="replica_inbox",
                                 )
+                            requestlog.log_result(requestlog.build_record(
+                                request.request_id, "shed_timeout",
+                                site="router",
+                                tenant=getattr(request, "tenant", None),
+                                tokens_in=len(request.input_ids),
+                                queue_wait_s=wait,
+                            ))
                             worked = True
                             continue
                         # Hand the engine only the REMAINING budget —
@@ -498,6 +505,12 @@ class Replica:
                                 error=str(e), num_tokens=0,
                                 shed_by="replica_inbox",
                             )
+                        requestlog.log_result(requestlog.build_record(
+                            request.request_id, f"rejected: {e}",
+                            site="router",
+                            tenant=getattr(request, "tenant", None),
+                            tokens_in=len(request.input_ids),
+                        ))
                     worked = True
                 try:
                     if engine.step():
@@ -1236,6 +1249,11 @@ class Router:
                 request_id=request.request_id, finish_reason=reason,
                 queue_wait_s=queue_wait_s, num_tokens=0, shed_by="router",
             )
+        requestlog.log_result(requestlog.build_record(
+            request.request_id, reason, site="router",
+            tenant=getattr(request, "tenant", None),
+            tokens_in=len(request.input_ids), queue_wait_s=queue_wait_s,
+        ))
 
     def _shed_prefill_entry(self, entry) -> None:
         """PrefillWorker deadline hook (worker thread): the
@@ -1273,6 +1291,12 @@ class Router:
                 error=f"{type(exc).__name__}: {exc}",
                 num_tokens=0, shed_by="router",
             )
+        requestlog.log_result(requestlog.build_record(
+            request.request_id, f"failed: {type(exc).__name__}: {exc}",
+            site="router", tenant=getattr(request, "tenant", None),
+            tokens_in=len(request.input_ids),
+            queue_wait_s=self.clock() - entry.submitted_at,
+        ))
 
     def submit(self, request: Request) -> Any:
         """Place one request. Sticky key first, else least-loaded ready
@@ -1669,6 +1693,32 @@ class Router:
                     "busy": r_busy,
                     "inflight_tokens": self._inflight.get(r.name, 0),
                 }
+            # Per-tenant quota view: every tenant with a declared class
+            # plus every tenant currently holding assignments, so a
+            # quota-less bursting tenant is still visible. Utilization
+            # also lands on the metering plane's labeled gauge
+            # (serve_tenant_quota_utilization) — the scrape and the
+            # report read the same number.
+            tenants: Dict[str, dict] = {}
+            seen = set(self.tenant_classes)
+            seen.update(
+                req.tenant
+                for _, req in self._assigned.values()
+                if req.tenant is not None
+            )
+            for tenant in sorted(seen):
+                cls = self.tenant_classes.get(tenant, {})
+                quota = cls.get(
+                    "max_inflight_tokens", self.tenant_quota_tokens
+                )
+                inflight = self._tenant_inflight(tenant)
+                util = (inflight / quota) if quota else 0.0
+                tenants[tenant] = {
+                    "inflight_tokens": inflight,
+                    "quota_tokens": quota,
+                    "quota_utilization": util,
+                }
+                metering.meter().set_quota_utilization(tenant, util)
             return {
                 "per_replica": per_replica,
                 "replicas": len(self.replicas),
@@ -1682,6 +1732,7 @@ class Router:
                 "outstanding": len(self._assigned),
                 "burning": self.burning,
                 "autoscale_hint": self._autoscale_hint(),
+                "tenants": tenants,
             }
 
     # -- the request lifecycle ------------------------------------------

@@ -1066,6 +1066,15 @@ def fit(
             logger(step_no, host_metrics)
         else:
             print(f"step {step_no}: {host_metrics}")
+        # Live numerics at log cadence: reads the CURRENT state (the
+        # closure sees fit's loop variable), which may be a few steps
+        # past the metrics being logged — staleness a telemetry gauge
+        # tolerates, a per-step device fetch would not.
+        from tpudl.train import precision as precision_mod
+
+        precision_mod.publish_numerics_telemetry(
+            getattr(state, "precision", None)
+        )
 
     def _deliver(results):
         """Hand drained (step, host_metrics) pairs to the logger — in

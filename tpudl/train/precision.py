@@ -317,6 +317,62 @@ def select_tree(ok: jax.Array, new: Any, old: Any) -> Any:
     return jax.tree.map(lambda a, b: jnp.where(ok, a, b), new, old)
 
 
+def publish_numerics_telemetry(precision_state: Any) -> None:
+    """Push the precision stack's live numerics into the obs registry
+    (the stack trained blind before this — a collapsing loss scale or
+    a drifting amax window was only visible post-mortem):
+
+    - ``train_loss_scale`` gauge — the current dynamic scale;
+    - ``train_grad_skipped_total`` counter — nonfinite-gradient skip
+      steps (the state's ``skipped`` is cumulative, so the counter is
+      advanced by delta and survives repeated publishes);
+    - ``train_fp8_amax_drift`` histogram — per-site ring spread
+      ``(max - min) / max`` over each amax window (x/w/g): near 0 =
+      stationary scales, near 1 = the site's magnitude moved an order
+      within the window and delayed scaling is chasing it.
+
+    Called from fit() at log cadence with the CURRENT TrainState
+    .precision (device fetches are per-publish, never per-step); a
+    None/empty state is a no-op, so f32/bf16-without-scaling runs pay
+    nothing."""
+    if not precision_state:
+        return
+    import numpy as np
+
+    from tpudl.obs import counters as obs_counters
+
+    reg = obs_counters.registry()
+    ls = precision_state.get("loss_scale")
+    if ls is not None:
+        reg.gauge("train_loss_scale").set(
+            float(jax.device_get(ls["scale"]))
+        )
+        skipped = int(jax.device_get(ls["skipped"]))
+        ctr = reg.counter("train_grad_skipped_total")
+        delta = skipped - int(ctr.value)
+        if delta > 0:
+            ctr.inc(delta)
+    fp8 = precision_state.get("fp8")
+    if fp8 is not None:
+        hist = reg.histogram("train_fp8_amax_drift")
+
+        def _walk(node: Any) -> None:
+            if not hasattr(node, "items"):
+                return
+            for key, val in node.items():
+                if hasattr(val, "items"):
+                    _walk(val)
+                elif str(key).endswith("_hist"):
+                    ring = np.asarray(
+                        jax.device_get(val), np.float32
+                    )
+                    hi = float(ring.max()) if ring.size else 0.0
+                    if hi > 0.0:
+                        hist.observe((hi - float(ring.min())) / hi)
+
+        _walk(fp8)
+
+
 # ---------------------------------------------------------------------------
 # Optimizer-moment precision (the rule-selected mu_dtype).
 # ---------------------------------------------------------------------------
