@@ -185,6 +185,71 @@ def test_truncated_open_tail_recovered_with_warning(tmp_path):
     w.close()
 
 
+def _write_raw_segment(d, idx, records, tail=b"", committed=False):
+    blob = b"".join(
+        (json.dumps(r) + "\n").encode("utf-8") for r in records
+    ) + tail
+    if committed:
+        crc = zlib.crc32(blob) & 0xFFFFFFFF
+        name = f"requests-{idx:06d}-{crc:08x}.jsonl"
+    else:
+        name = f"requests-{idx:06d}.open.jsonl"
+    with open(os.path.join(d, name), "wb") as f:
+        f.write(blob)
+
+
+def test_orphan_open_mid_log_tolerated_by_reader(tmp_path):
+    """Tail tolerance follows COMMITMENT, not position: a crashed
+    process's torn .open segment stays readable (intact prefix, loud
+    warning) even once a restarted writer has published newer segments
+    behind it — it must never flip the whole log to
+    RequestLogCorruptError."""
+    d = str(tmp_path)
+    _write_raw_segment(
+        d, 0, [_rec(i) for i in range(3)], tail=b'{"torn'
+    )
+    _write_raw_segment(
+        d, 1, [_rec(i) for i in range(3, 6)], committed=True
+    )
+    with pytest.warns(RuntimeWarning, match="truncated"):
+        records = list(requestlog.read_request_log(d))
+    assert _ids(records) == [f"r{i}" for i in range(6)]
+
+
+def test_restart_seals_orphan_open_segment(tmp_path):
+    """A new writer crc-seals a predecessor's orphaned .open segment on
+    startup — torn final line trimmed loudly, intact records upgraded
+    to full crc protection, nothing left uncommitted mid-log."""
+    d = str(tmp_path)
+    _write_raw_segment(
+        d, 0, [_rec(i) for i in range(3)], tail=b'{"torn'
+    )
+    with pytest.warns(RuntimeWarning, match="torn record"):
+        w = requestlog.RequestLogWriter(d, segment_bytes=1 << 20)
+    w.log(_rec(3))
+    w.close()
+    assert not any(
+        n.endswith(".open.jsonl") for n in os.listdir(d)
+    )
+    segs = requestlog.list_segments(d)
+    assert [idx for idx, _, _ in segs] == [0, 1]
+    for _, crc, path in segs:
+        assert crc is not None
+        with open(path, "rb") as f:
+            assert (zlib.crc32(f.read()) & 0xFFFFFFFF) == crc
+    assert (
+        obs_counters.registry()
+        .counter("requestlog_orphans_sealed").value == 1
+    )
+    # Fully committed now: reading warns about nothing.
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        records = list(requestlog.read_request_log(d))
+    assert _ids(records) == ["r0", "r1", "r2", "r3"]
+
+
 def test_damaged_committed_tail_recovers_prefix(tmp_path):
     """A committed TAIL whose crc no longer matches degrades to loud
     line-by-line recovery instead of raising."""
@@ -343,6 +408,27 @@ def test_meter_rollup_and_shed_bucketing():
     assert base["requests_total"] == 1
     # Base-model requests hold no adapter: residency stays 0.
     assert base["adapter_residency_s"] == 0.0
+
+
+def test_meter_free_text_reasons_stay_closed_set():
+    """Both free-text finish_reason families — ``failed: <exc>`` from
+    the engine and ``rejected: <exc>`` from the router — collapse to
+    ONE sheds bucket each: the Prometheus metric names render() mints
+    from sheds keys must not grow per distinct exception message."""
+    m = metering.TenantMeter()
+    m.ingest(_rec(0, tenant="a",
+                  finish_reason="rejected: ValueError: too long"))
+    m.ingest(_rec(1, tenant="a",
+                  finish_reason="rejected: ValueError: duplicate id"))
+    m.ingest(_rec(2, tenant="a",
+                  finish_reason="failed: RuntimeError: boom"))
+    m.ingest(_rec(3, tenant="a",
+                  finish_reason="failed: OSError: disk"))
+    assert m.tenants()["a"]["sheds"] == {"rejected": 2, "failed": 2}
+    text = m.render()
+    assert 'serve_tenant_requests_rejected{tenant="a"} 2' in text
+    assert 'serve_tenant_requests_failed{tenant="a"} 2' in text
+    assert "ValueError" not in text and "RuntimeError" not in text
 
 
 def test_meter_render_tenant_labels():

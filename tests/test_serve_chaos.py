@@ -233,6 +233,51 @@ def test_migration_crc_guard(programs):
     )
 
 
+def test_failed_migration_bills_payload_tenant(programs):
+    """The terminal record of a migration that cannot resume carries
+    the payload's tenant, prompt length, and CUMULATIVE hop count —
+    failed migrated requests must not be metered under ``_base`` (the
+    failure class multi-tenant billing most needs to see)."""
+    from tpudl.obs import metering
+
+    # The hop count rides the payload (export stamps hops survived).
+    src = _session(programs)
+    src.submit(Request("rm", [3, 5, 7], max_new_tokens=12))
+    src.engine.step()
+    assert parse_migration(src.engine.export_request("rm"))[
+        "migrations"
+    ] == 0
+
+    dst = _session(programs)
+    meter = metering.meter()
+    meter.reset()
+    try:
+        dst.engine._fail_migrated(
+            "rx", RuntimeError("boom"),
+            meta={
+                "request": {
+                    "tenant": "acme", "input_ids": [1, 2, 3, 4],
+                },
+                "migrations": 2,
+            },
+        )
+        snap = meter.tenants()
+        assert metering.BASE_TENANT not in snap
+        a = snap["acme"]
+        assert a["requests_total"] == 1
+        assert a["tokens_in"] == 4
+        assert a["migrations"] == 3  # 2 survived hops + this failure
+        assert a["sheds"] == {"failed": 1}
+        # A corrupt transfer has no parsed meta: the fallback still
+        # lands the record (under _base) instead of crashing.
+        dst.engine._fail_migrated("ry", RuntimeError("crc"), meta=None)
+        assert meter.tenants()[metering.BASE_TENANT][
+            "migrations"
+        ] == 1
+    finally:
+        meter.reset()
+
+
 def test_migration_deadline_rides_payload(programs):
     """The absolute deadline stamp rides the payload: a target inside
     the budget seats and honors the remainder; a transfer that

@@ -120,10 +120,19 @@ class TenantMeter:
                 u.requests_completed += 1
             else:
                 # Every non-completion is a shed class (shed_*,
-                # failover_exhausted, failed: ...) — normalize the
-                # failed family to one bucket so label values stay a
-                # closed set.
-                key = "failed" if reason.startswith("failed") else reason
+                # failover_exhausted, failed: ..., rejected: ...) —
+                # normalize BOTH free-text families ("failed: <exc>"
+                # from the engine, "rejected: <exc>" from the router)
+                # to one bucket each, so sheds keys (and the Prometheus
+                # metric NAMES render() mints from them) stay a closed
+                # set instead of growing one series per distinct
+                # exception message.
+                if reason.startswith("failed"):
+                    key = "failed"
+                elif reason.startswith("rejected"):
+                    key = "rejected"
+                else:
+                    key = reason
                 u.sheds[key] = u.sheds.get(key, 0) + 1
 
     def set_quota_utilization(

@@ -26,10 +26,15 @@ stalling a serving engine on disk latency.
 
 ``read_request_log(dir)`` / ``RequestLogReader`` iterate segments in
 index order, verify each committed segment's crc, skip a truncated or
-corrupt TAIL loudly (``warnings.warn``) while recovering every intact
-record before the tear, and raise ``RequestLogCorruptError`` on
-non-tail corruption (silent data loss in the middle of the log is the
-one unforgivable outcome). The reader's ``state()``/``seek()`` speak
+corrupt tail loudly (``warnings.warn``) while recovering every intact
+record before the tear — and extend the same tolerance to ANY
+uncommitted ``.open`` segment regardless of position, since a crashed
+process's orphan stays torn even after a restarted writer opens newer
+segments behind it (a new writer also crc-seals such orphans on
+startup, trimming the torn line first). Corruption inside a committed
+non-final segment raises ``RequestLogCorruptError`` (silent data loss
+in the middle of the log is the one unforgivable outcome). The
+reader's ``state()``/``seek()`` speak
 the exact ``{"epoch": segment, "offset": record}`` contract of
 ``tpudl.ft.data.ResumableIterator`` — the flywheel ingest resumes
 mid-log across restarts like a data loader resumes mid-epoch.
@@ -175,6 +180,7 @@ class RequestLogWriter:
         self.segment_bytes = segment_bytes
         self.clock = clock
         os.makedirs(directory, exist_ok=True)
+        self._seal_orphans(directory)
         existing = list_segments(directory)
         # Never append into a previous process's segments (its .open
         # tail may be torn; its committed names are immutable): start
@@ -192,6 +198,52 @@ class RequestLogWriter:
             target=self._run, name="tpudl-requestlog", daemon=True
         )
         self._thread.start()
+
+    @staticmethod
+    def _seal_orphans(directory: str) -> None:
+        """Commit any ``.open`` segment a crashed predecessor left
+        behind: trim the torn final line (if any), fsync, and publish
+        under the crc name. Without this, the orphan would sit
+        uncommitted in the MIDDLE of the log forever once this writer
+        opens higher-indexed segments behind it — readable only via
+        the reader's uncommitted-segment tolerance. Sealing upgrades
+        its intact records to full crc protection."""
+        for idx, crc, path in list_segments(directory):
+            if crc is not None:
+                continue
+            with open(path, "rb") as f:
+                blob = f.read()
+            kept = bytearray()
+            torn = 0
+            for line in blob.split(b"\n"):
+                if not line.strip():
+                    continue
+                try:
+                    json.loads(line)
+                except json.JSONDecodeError:
+                    torn += 1
+                    continue
+                kept += line + b"\n"
+            if torn or len(kept) != len(blob):
+                if torn:
+                    warnings.warn(
+                        f"request-log orphan segment {path} had "
+                        f"{torn} torn record(s); sealing the intact "
+                        f"prefix",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
+                with open(path, "wb") as f:
+                    f.write(bytes(kept))
+            _fsync_file(path)
+            new_crc = zlib.crc32(bytes(kept)) & 0xFFFFFFFF
+            final = os.path.join(
+                directory,
+                f"{_PREFIX}{idx:06d}-{new_crc:08x}{_COMMIT_SUFFIX}",
+            )
+            os.rename(path, final)
+            _fsync_dir(directory)
+            registry().counter("requestlog_orphans_sealed").inc()
 
     # -- hot path ------------------------------------------------------
 
@@ -277,8 +329,10 @@ class RequestLogWriter:
     # -- lifecycle -----------------------------------------------------
 
     def flush(self) -> None:
-        """Block until every already-enqueued record is on disk (still
-        possibly in the uncommitted ``.open`` segment)."""
+        """Block until every already-enqueued record has been handed to
+        the OS (written + ``file.flush()``, still uncommitted in the
+        ``.open`` segment and NOT fsynced — durability against power
+        loss only comes with segment commit)."""
         if self._closed:
             return
         try:
@@ -295,6 +349,18 @@ class RequestLogWriter:
         self._queue.join()
         self._queue.put(_STOP)
         self._thread.join(timeout=30.0)
+        if self._thread.is_alive():
+            # A hung disk write left the writer thread running; racing
+            # it on self._file from this thread could interleave a
+            # commit with an in-flight append. Leave the .open segment
+            # for the next writer's orphan sealing.
+            warnings.warn(
+                "request-log writer thread did not exit within 30s; "
+                "leaving the .open segment uncommitted",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return
         self._commit_segment()
 
 
@@ -309,8 +375,10 @@ _FLUSH_ONLY = object()
 
 def segment_records(path: str, crc: Optional[int], is_tail: bool) -> list:
     """Parse one segment. Committed segments verify the whole-payload
-    crc first; the TAIL segment (committed-but-damaged or ``.open``)
-    degrades to loud line-by-line recovery; non-tail damage raises."""
+    crc first; a TOLERANT segment (``is_tail=True``: the final segment,
+    or any uncommitted ``.open`` segment regardless of position — a
+    crash's orphan stays torn even once newer segments exist behind it)
+    degrades to loud line-by-line recovery; other damage raises."""
     with open(path, "rb") as f:
         blob = f.read()
     damaged = crc is not None and (zlib.crc32(blob) & 0xFFFFFFFF) != crc
@@ -398,7 +466,15 @@ class RequestLogReader:
             return None
         if self._records is None:
             _, crc, path = self._segments[self._seg_pos]
-            is_tail = self._seg_pos == len(self._segments) - 1
+            # Tail tolerance is about COMMITMENT, not position: any
+            # uncommitted (.open, crc None) segment may be torn — a
+            # crashed process's orphan stays torn even after a new
+            # writer opens higher-indexed segments behind it. Only a
+            # crc-committed segment that is not the last one forfeits
+            # tolerance.
+            is_tail = (
+                crc is None or self._seg_pos == len(self._segments) - 1
+            )
             self._records = segment_records(path, crc, is_tail)
         return self._records
 

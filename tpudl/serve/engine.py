@@ -687,7 +687,7 @@ class Engine:
                         "migrated reservation cannot fit this cache "
                         "even empty"
                     ),
-                    lease=item.lease,
+                    lease=item.lease, meta=meta,
                 )
                 continue
             self.migrate_inbox.popleft()
@@ -697,7 +697,7 @@ class Engine:
                     ValueError, RuntimeError) as e:
                 # install/import released the lease on their own
                 # failure paths — report only.
-                self._fail_migrated(item.rid, e)
+                self._fail_migrated(item.rid, e, meta=meta)
         # Externally prefilled requests (disaggregation) seat first:
         # their prefill cost is already paid, a queue pop would re-pay
         # it locally.
@@ -890,6 +890,10 @@ class Engine:
             "t_seated": s.t_seated,
             "t_first": s.t_first,
             "t_last": s.t_last,
+            # Hops survived so far: rides the payload so the target's
+            # terminal record counts migrations CUMULATIVELY (and a
+            # failed install can attribute the full hop count).
+            "migrations": s.migrations,
             # What the target must reserve: rows written so far plus
             # one page-write per token still to generate.
             "reserve_tokens": int(self.cache.lens[slot])
@@ -1007,10 +1011,10 @@ class Engine:
         s.steps = int(meta["steps"])
         s.t_last = float(meta["t_last"])
         s.gap_origin = float(meta["t_last"])
-        # The terminal record counts hops: each install is one
-        # migration survived (the source engine's accumulators do not
-        # ride the payload — usage before the move was already metered
-        # on the source's spans).
+        # The terminal record counts hops cumulatively: the payload
+        # carries the count survived BEFORE this move, and this install
+        # is one more (usage before the move was already metered on the
+        # source's spans — only the hop count rides).
         s.migrations = int(meta.get("migrations", 0)) + 1
         self._slots[slot] = s
         registry().counter("serve_migrations_installed").inc()
@@ -1027,11 +1031,16 @@ class Engine:
         return req.request_id
 
     def _fail_migrated(self, rid: Any, exc: BaseException,
-                       lease=None) -> None:
+                       lease=None, meta: Optional[dict] = None) -> None:
         """A migrated payload that cannot be resumed (corrupt transfer,
         incompatible cache, unseatable reservation) surfaces as a
         ``failed`` Result — the generation state is gone and silently
-        resuming garbage is forbidden, so honesty is all that's left."""
+        resuming garbage is forbidden, so honesty is all that's left.
+        ``meta`` is the parsed payload when the transfer survived the
+        crc: it carries tenant, prompt length, and accumulated hop
+        count, so the terminal record bills the RIGHT tenant instead of
+        ``_base`` (a corrupt transfer has no meta — those fields fall
+        back to unknown)."""
         if lease is not None and self.paged:
             self.cache.release_lease(lease[1])
         self.results[rid] = Result(
@@ -1041,17 +1050,21 @@ class Engine:
         reg = registry()
         reg.counter("serve_requests_failed").inc()
         reg.counter("serve_migrations_failed").inc()
+        mreq = (meta or {}).get("request") or {}
+        tenant = mreq.get("tenant")
+        tokens_in = len(mreq.get("input_ids") or [])
+        migrations = int((meta or {}).get("migrations", 0) or 0) + 1
         rec = active_recorder()
         if rec is not None:
             rec.event(
                 "request_complete", CAT_SERVE_REQUEST, request_id=rid,
                 finish_reason="failed",
                 error=f"{type(exc).__name__}: {exc}", num_tokens=0,
-                shed_by="migration",
+                shed_by="migration", tenant=tenant,
             )
         requestlog.log_result(requestlog.build_record(
             rid, f"failed: {type(exc).__name__}: {exc}", site="engine",
-            migrations=1,
+            tenant=tenant, tokens_in=tokens_in, migrations=migrations,
         ))
 
     def _fits_migrated(self, meta: dict) -> bool:
