@@ -549,6 +549,20 @@ def _bench_requestlog():
     return measure_requestlog()
 
 
+def _bench_flywheel():
+    """Data-flywheel tier (tpudl.flywheel via benchmarks/
+    serve_load.py): the steady-state refresh latency — one
+    ``FlywheelController.poll()`` wall time (log flush -> filter ->
+    LoRA train -> safe hot-swap) with the train step pre-compiled —
+    and the ingestion tax: serving p99 TTFT with sample capture + the
+    durable log on over the same closed-loop mix with them off. The
+    serve -> refresh -> swap cycle is asserted end-to-end inside the
+    benchmark. Banked from r18 onward (lower is better for both)."""
+    from benchmarks.serve_load import measure_flywheel
+
+    return measure_flywheel()
+
+
 def _bench_ft():
     """Fault-tolerance costs (benchmarks/ft_recovery.py): the async
     checkpoint's on-step stall and the kill-to-first-post-restart-step
@@ -728,6 +742,15 @@ def main(argv=None):
         print("request-log bench failed:", file=sys.stderr)
         traceback.print_exc()
         rlog = {}
+    try:
+        flywheel = _bench_flywheel()
+    except Exception:
+        import sys
+        import traceback
+
+        print("flywheel bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        flywheel = {}
     try:
         ft = _bench_ft()
     except Exception:
@@ -944,6 +967,19 @@ def main(argv=None):
         ),
         "requestlog_bytes_per_request": rlog.get(
             "requestlog_bytes_per_request"
+        ),
+        # Data flywheel (tpudl.flywheel via benchmarks/serve_load.py):
+        # the steady-state refresh lag — one controller poll's wall
+        # time from record threshold to refreshed factors swapped in
+        # (train step pre-compiled) — and the ingestion tax, serving
+        # p99 TTFT with sample capture + the durable log on vs off
+        # over the same closed-loop mix (the serve -> refresh -> swap
+        # cycle asserted inside the benchmark).
+        "flywheel_refresh_latency_s": flywheel.get(
+            "flywheel_refresh_latency_s"
+        ),
+        "flywheel_serving_p99_impact_ratio": flywheel.get(
+            "flywheel_serving_p99_impact_ratio"
         ),
         # Fault tolerance (tpudl.ft via benchmarks/
         # ft_recovery.py): the async checkpoint's mean on-step

@@ -140,6 +140,15 @@ NOISE_BAND_FLOORS = {
     # drift means the schema or the mix changed.
     "requestlog_overhead_p99_ttft_ratio": 0.50,
     "requestlog_bytes_per_request": 0.08,
+    # Data-flywheel keys (benchmarks/serve_load.py, banked from r18).
+    # Refresh latency is a handful of tiny train steps plus a pool
+    # register on a 1-vCPU host that is also paging XLA programs —
+    # scheduler jitter dominates a sub-100ms wall time. The impact
+    # ratio is two p99 TTFT tails of the same closed loop (the
+    # requestlog overhead band's shape, plus sample capture), so it
+    # inherits the same wide band.
+    "flywheel_refresh_latency_s": 0.60,
+    "flywheel_serving_p99_impact_ratio": 0.50,
 }
 DEFAULT_BAND_FLOOR = 0.08
 
@@ -161,6 +170,8 @@ LOWER_IS_BETTER = {
     "serve_tenant_isolation_p99_ratio",
     "requestlog_overhead_p99_ttft_ratio",
     "requestlog_bytes_per_request",
+    "flywheel_refresh_latency_s",
+    "flywheel_serving_p99_impact_ratio",
 }
 
 #: Lower-is-better metrics whose banked baseline is 0 and must STAY 0:

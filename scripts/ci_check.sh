@@ -44,6 +44,10 @@ echo "== request-log smoke (durable JSONL round-trip + per-tenant token reconcil
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmarks.serve_load \
     --requestlog --requests 4 > /dev/null
 
+echo "== flywheel smoke (samples on -> one LoRA refresh -> safe hot-swap asserted)"
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmarks.serve_load \
+    --flywheel --requests 8 > /dev/null
+
 echo "== chaos smoke (serving fault injection: migration, failover, drains)"
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest tests/ -q -m 'chaos and not slow' \
     -p no:cacheprovider

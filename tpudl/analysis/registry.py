@@ -112,6 +112,11 @@ _declare("TPUDL_OBS_REQUEST_LOG_QUEUE", "int", 1024,
          "(counted in requestlog_records_dropped) instead of blocking "
          "the decode loop.",
          "tpudl.obs.requestlog")
+_declare("TPUDL_OBS_REQUEST_LOG_SAMPLES", "flag", False,
+         "Capture prompt/output token ids on COMPLETED request-log "
+         "records (schema v2 optional fields — the flywheel's "
+         "training feedstock); off = records carry metrics only.",
+         "tpudl.obs.requestlog")
 _declare("TPUDL_PROFILE_DIR", "path", None,
          "jax.profiler trace output directory for fit(profile=...).",
          "tpudl.train.loop")
@@ -218,6 +223,20 @@ _declare("TPUDL_SERVE_MAX_FAILOVERS", "int", 3,
          "failover_exhausted instead of looping forever (migrations "
          "resume state and do not count).",
          "tpudl.serve.router")
+
+# --- flywheel ------------------------------------------------------------
+_declare("TPUDL_FLYWHEEL_MIN_RECORDS", "int", 8,
+         "New completed records a tenant must accrue (TenantMeter "
+         "delta since its last refresh) before the controller "
+         "triggers a LoRA refresh.",
+         "tpudl.flywheel.loop")
+_declare("TPUDL_FLYWHEEL_INTERVAL_S", "float", 30.0,
+         "FlywheelController.watch() poll cadence in seconds.",
+         "tpudl.flywheel.loop")
+_declare("TPUDL_FLYWHEEL_PRECISION", "str", "bf16",
+         "RefreshTrainer precision policy preset (f32 | bf16 | fp8); "
+         "fp8 opens the fp8-base x LoRA-factor training cell.",
+         "tpudl.flywheel.refresh")
 
 # --- fault tolerance / chaos --------------------------------------------
 _declare("TPUDL_FT_GRACE_S", "float", 15.0,

@@ -1,0 +1,44 @@
+"""tpudl.flywheel — per-tenant continual LoRA refresh from live traffic.
+
+The consuming half of the PR 16 ingestion stack (ROADMAP item 4's
+"NLP at scale" loop, closed): served traffic lands in the durable
+request log with optional token samples (schema v2,
+``TPUDL_OBS_REQUEST_LOG_SAMPLES``), a declarative ``SampleFilter``
+turns raw records into per-tenant training examples on a resumable
+log position, a ``RefreshTrainer`` trains ONLY the tenant's LoRA
+factors under a ``tpudl.train.precision`` policy (checkpointing
+factors + log position, preemption-safe), and the
+``FlywheelController`` hot-swaps the refreshed factors back into the
+serving ``AdapterPool`` under the PR 14 safe-publish contract — the
+next request serves the refreshed adapter, zero serving recompiles.
+
+Module map (one seam each):
+
+- ``samples``  — record <-> training-example conversion + fixed-shape
+  batch packing (the zero-recompile contract for the trainer).
+- ``filter``   — ``SampleFilter`` (tpudl.rules first-match shape) +
+  ``SampleStream`` over ``ft.data.resumable_request_log``.
+- ``refresh``  — ``RefreshTrainer``: frozen-base LoRA training,
+  precision policy, AsyncCheckpointManager + preemption resume.
+- ``loop``     — ``FlywheelController``: TenantMeter deltas ->
+  refresh trigger -> AdapterPool.register safe publish + telemetry.
+"""
+
+from tpudl.flywheel.filter import SampleFilter, SampleStream
+from tpudl.flywheel.loop import FlywheelController
+from tpudl.flywheel.refresh import RefreshTrainer
+from tpudl.flywheel.samples import (
+    example_from_record,
+    has_sample,
+    pack_examples,
+)
+
+__all__ = [
+    "FlywheelController",
+    "RefreshTrainer",
+    "SampleFilter",
+    "SampleStream",
+    "example_from_record",
+    "has_sample",
+    "pack_examples",
+]

@@ -1,0 +1,206 @@
+"""Declarative sample filtering over the durable request log.
+
+``SampleFilter`` decides, record by record, what becomes a training
+example. Policy is data, in the ``tpudl.rules`` first-match shape the
+sharding/quantization/precision engines already use: an ordered
+``(regex, "keep" | "drop")`` list matched against the record's
+``"{tenant}/{finish_reason}"`` path — tenant allow/deny lists and
+finish-reason policy are one mechanism, first match wins, and the
+``default`` covers the rest explicitly (no silent fallthrough).
+
+On top of the rule verdict sit the structural gates:
+
+- sample presence — v1 records (and v2 records written with capture
+  off) carry no token ids; they are SKIPPED LOUDLY (one warning per
+  filter + a counted stat) per the schema version contract, never an
+  error: old log segments stay consumable.
+- min/max output-token bounds — degenerate one-token completions and
+  runaway maxima both train badly.
+- dedup by prompt-prefix hash — repeated identical prompts (health
+  checks, retries) would otherwise dominate a tenant's refresh.
+
+``SampleStream`` binds a filter to ``ft.data.resumable_request_log``:
+iterating yields admitted examples while ``state()`` reports the log
+``(epoch, offset)`` position — the exact dict a refresh checkpoint
+carries, so a resumed refresh re-reads not a single record.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from tpudl import rules as rules_mod
+from tpudl.flywheel.samples import example_from_record
+from tpudl.ft.data import resumable_request_log
+
+#: Rule verdicts. Anything else in a rule's value raises at the door.
+KEEP = "keep"
+DROP = "drop"
+
+#: Prompt-prefix length (tokens) the dedup hash covers.
+DEFAULT_DEDUP_PREFIX = 16
+
+
+class SampleFilter:
+    """First-match record filter producing per-tenant training
+    examples.
+
+    ``rules``: ordered ``(pattern, "keep"|"drop")`` pairs matched
+    (``re.search``, first match wins) against ``"{tenant}/
+    {finish_reason}"`` — e.g. ``((r"^tenant-a/", "drop"),
+    (r"/eos$", "keep"))`` drops tenant-a entirely and keeps only
+    eos-finished completions from everyone else when ``default=
+    "drop"``. ``None`` tenants match as the literal ``"-"`` (the
+    metering BASE_TENANT convention: base-model traffic is usually
+    dropped by tenant rules, since there is no adapter to refresh).
+
+    ``stats()`` exposes the admission ledger; ``reset_dedup()`` clears
+    the seen-prefix set (a controller does this per refresh so dedup
+    is per-refresh, not forever)."""
+
+    def __init__(
+        self,
+        rules: Sequence[Tuple[str, str]] = (),
+        *,
+        default: str = KEEP,
+        min_output_tokens: int = 1,
+        max_output_tokens: Optional[int] = None,
+        dedup_prefix: int = DEFAULT_DEDUP_PREFIX,
+    ):
+        for pattern, verdict in rules:
+            if verdict not in (KEEP, DROP):
+                raise ValueError(
+                    f"rule {pattern!r}: verdict must be "
+                    f"{KEEP!r} or {DROP!r}, got {verdict!r}"
+                )
+        if default not in (KEEP, DROP):
+            raise ValueError(
+                f"default must be {KEEP!r} or {DROP!r}, got {default!r}"
+            )
+        if min_output_tokens < 1:
+            raise ValueError(
+                f"min_output_tokens must be >= 1, got {min_output_tokens}"
+            )
+        self.rules = tuple(rules)
+        self.default = default
+        self.min_output_tokens = min_output_tokens
+        self.max_output_tokens = max_output_tokens
+        self.dedup_prefix = dedup_prefix
+        self._seen: set = set()
+        self._warned_no_sample = False
+        self._stats = {
+            "records": 0,
+            "admitted": 0,
+            "dropped_rule": 0,
+            "dropped_no_sample": 0,
+            "dropped_bounds": 0,
+            "dropped_duplicate": 0,
+        }
+
+    def _path(self, record: dict) -> str:
+        tenant = record.get("tenant")
+        return f"{tenant if tenant is not None else '-'}/" \
+               f"{record.get('finish_reason', '?')}"
+
+    def admit(self, record: dict) -> Optional[Dict]:
+        """The example this record yields, or None (with the drop
+        reason counted in ``stats()``)."""
+        self._stats["records"] += 1
+        verdict = rules_mod.first_match(self.rules, self._path(record))
+        if verdict is rules_mod.NO_MATCH:
+            verdict = self.default
+        if verdict == DROP:
+            self._stats["dropped_rule"] += 1
+            return None
+        example = example_from_record(record)
+        if example is None:
+            # The v1-compat path: a record without samples is a valid
+            # record that simply predates (or opted out of) capture.
+            self._stats["dropped_no_sample"] += 1
+            if not self._warned_no_sample:
+                self._warned_no_sample = True
+                warnings.warn(
+                    "SampleFilter: request-log record(s) without "
+                    "prompt_ids/output_ids samples (schema v1, or "
+                    "TPUDL_OBS_REQUEST_LOG_SAMPLES was off when they "
+                    "were served) — skipping them; see "
+                    "stats()['dropped_no_sample'] for the count",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+            return None
+        n_out = len(example["output_ids"])
+        if n_out < self.min_output_tokens or (
+            self.max_output_tokens is not None
+            and n_out > self.max_output_tokens
+        ):
+            self._stats["dropped_bounds"] += 1
+            return None
+        key = (
+            example["tenant"],
+            tuple(example["prompt_ids"][: self.dedup_prefix]),
+        )
+        if key in self._seen:
+            self._stats["dropped_duplicate"] += 1
+            return None
+        self._seen.add(key)
+        self._stats["admitted"] += 1
+        return example
+
+    def stats(self) -> Dict[str, int]:
+        return dict(self._stats)
+
+    def reset_dedup(self) -> None:
+        self._seen.clear()
+
+
+class SampleStream:
+    """Admitted examples from a request-log directory, with the log
+    position riding along.
+
+    The underlying ``resumable_request_log`` snapshots the segment set
+    at construction — a LIVE log needs a fresh ``SampleStream`` per
+    poll, seeked to the last checkpointed ``state()`` (exactly how
+    ``FlywheelController`` consumes it). ``state()`` after pulling an
+    example points one record PAST it: resume never re-trains on a
+    consumed sample."""
+
+    def __init__(
+        self,
+        directory: str,
+        filter: SampleFilter,
+        state: Optional[Dict[str, int]] = None,
+    ):
+        self.filter = filter
+        self._it = resumable_request_log(directory)
+        if state:
+            self._it.seek(state)
+
+    def state(self) -> Dict[str, int]:
+        return self._it.state()
+
+    def __iter__(self) -> Iterator[Dict]:
+        return self
+
+    def __next__(self) -> Dict:
+        while True:
+            record = next(self._it)  # StopIteration ends the stream
+            example = self.filter.admit(record)
+            if example is not None:
+                return example
+
+    def take(
+        self, tenant: Any, limit: Optional[int] = None
+    ) -> List[Dict]:
+        """Drain the snapshot, returning ONLY ``tenant``'s examples
+        (other tenants' records advance the position — per-tenant
+        positions mean each tenant scans the log independently)."""
+        out: List[Dict] = []
+        for example in self:
+            if example["tenant"] != tenant:
+                continue
+            out.append(example)
+            if limit is not None and len(out) >= limit:
+                break
+        return out

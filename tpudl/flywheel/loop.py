@@ -1,0 +1,316 @@
+"""The flywheel controller: meter deltas -> refresh -> safe hot-swap.
+
+``FlywheelController`` closes the loop the rest of the package builds:
+it watches the ``TenantMeter`` for tenants accruing completed records
+(``TPUDL_FLYWHEEL_MIN_RECORDS`` new since their last refresh), pulls
+their samples from the durable request log through a ``SampleFilter``
+at each tenant's OWN remembered log position, trains factors with the
+``RefreshTrainer``, and publishes via ``AdapterPool.register`` under
+the PR 14 safe-publish contract:
+
+- refcount-0 residency is invalidated (pages freed, prefix reuse for
+  the old factors gone with them) — the NEXT request seats the
+  refreshed factors;
+- a tenant mid-request (refcount > 0) makes ``register`` raise — the
+  controller treats that as backpressure, stashes the factors, and
+  retries at the next poll. A lease is never swapped under.
+
+The controller is deliberately synchronous and poll-driven: ``poll()``
+does one scan (call it from a supervisor, a test, or ``watch()``'s
+``TPUDL_FLYWHEEL_INTERVAL_S`` loop). Refresh history persists as
+``flywheel-state.json`` next to the log segments — ``report.py
+--flywheel`` renders it, and counters/gauges
+(``flywheel_refreshes_total``, ``flywheel_records_consumed_total``,
+``flywheel_swap_age_s``) ride the live exporter like every other
+subsystem's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from tpudl.flywheel.filter import SampleFilter, SampleStream
+from tpudl.obs import counters as obs_counters
+from tpudl.obs import metering, requestlog
+
+#: Filename (inside the log directory) for the persisted refresh
+#: history ``report.py --flywheel`` reads.
+STATE_FILENAME = "flywheel-state.json"
+
+DEFAULT_MIN_RECORDS = 8
+
+
+def min_records_default() -> int:
+    from tpudl.analysis.registry import env_int
+
+    return env_int(
+        "TPUDL_FLYWHEEL_MIN_RECORDS", DEFAULT_MIN_RECORDS, min_value=1
+    )
+
+
+def interval_default() -> float:
+    from tpudl.analysis.registry import env_float
+
+    return max(0.0, env_float("TPUDL_FLYWHEEL_INTERVAL_S", 30.0))
+
+
+class FlywheelController:
+    """Per-tenant refresh orchestration over one serving session.
+
+    ``session`` needs an ``AdapterPool`` (``session.engine.
+    adapter_pool``, the ``ServeSession`` shape, or ``session.
+    adapter_pool`` directly; no pool = nothing to swap into, the
+    controller is inert). ``trainer`` is a
+    ``RefreshTrainer`` built against the session's model config and
+    base params. ``checkpoint_dir`` (optional) gives each tenant's
+    refresh an ``ft.AsyncCheckpointManager`` under
+    ``{checkpoint_dir}/{tenant}`` — a refresh preempted mid-train
+    resumes schedule-identical at the next poll."""
+
+    def __init__(
+        self,
+        session: Any,
+        log_dir: str,
+        trainer: Any,
+        *,
+        filter: Optional[SampleFilter] = None,
+        min_records: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        alpha: Optional[float] = None,
+        clock=time.time,
+    ):
+        self.session = session
+        self.log_dir = str(log_dir)
+        self.trainer = trainer
+        self.filter = filter if filter is not None else SampleFilter()
+        self.min_records = (
+            int(min_records)
+            if min_records is not None
+            else min_records_default()
+        )
+        self.checkpoint_dir = checkpoint_dir
+        self.alpha = float(
+            alpha if alpha is not None else trainer.alpha
+        )
+        self._clock = clock
+        #: completed-record count at each tenant's last refresh.
+        self._consumed: Dict[str, int] = {}
+        #: each tenant's request-log position (epoch/offset dict).
+        self._positions: Dict[str, dict] = {}
+        #: trained factors awaiting a lease-free publish window.
+        self._pending_swap: Dict[str, dict] = {}
+        #: the latest factors per tenant (warm start for the next
+        #: refresh, whether or not the swap landed yet).
+        self._adapters: Dict[str, dict] = {}
+        self._history: List[dict] = []
+        self._last_swap_ts: Optional[float] = None
+        self._load_state()
+
+    # -- persistence ---------------------------------------------------
+
+    @property
+    def state_path(self) -> str:
+        return os.path.join(self.log_dir, STATE_FILENAME)
+
+    def _load_state(self) -> None:
+        try:
+            with open(self.state_path, "r", encoding="utf-8") as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            return
+        self._consumed = {
+            str(k): int(v)
+            for k, v in blob.get("consumed", {}).items()
+        }
+        self._positions = dict(blob.get("positions", {}))
+        self._history = list(blob.get("history", []))
+        self._last_swap_ts = blob.get("last_swap_ts")
+
+    def _save_state(self) -> None:
+        blob = {
+            "consumed": self._consumed,
+            "positions": self._positions,
+            "history": self._history,
+            "last_swap_ts": self._last_swap_ts,
+        }
+        tmp = self.state_path + ".tmp"
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(blob, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.state_path)
+
+    # -- the poll ------------------------------------------------------
+
+    def _pool(self):
+        engine = getattr(self.session, "engine", None)
+        pool = getattr(engine, "adapter_pool", None)
+        if pool is None:
+            pool = getattr(self.session, "adapter_pool", None)
+        return pool
+
+    def poll(self) -> List[dict]:
+        """One scan: retry pending swaps, then check every pool tenant
+        for enough new completed records and refresh the ones over the
+        threshold. Returns this poll's new history entries."""
+        pool = self._pool()
+        if pool is None:
+            return []
+        self._retry_pending(pool)
+        writer = requestlog.active_writer()
+        if writer is not None:
+            # Blocks until enqueued records are written to the .open
+            # tail — the reader sees everything served so far.
+            writer.flush()
+        usage = metering.meter().tenants()
+        entries: List[dict] = []
+        for tenant in list(pool.tenants):
+            stats = usage.get(tenant)
+            if not stats:
+                continue
+            completed = int(stats.get("requests_completed", 0))
+            delta = completed - self._consumed.get(tenant, 0)
+            if delta < self.min_records:
+                continue
+            entry = self._refresh(pool, tenant, completed)
+            if entry is not None:
+                entries.append(entry)
+        if entries:
+            self._save_state()
+        self._update_gauges()
+        return entries
+
+    def _retry_pending(self, pool) -> None:
+        for tenant in list(self._pending_swap):
+            factors = self._pending_swap[tenant]
+            if self._publish(pool, tenant, factors):
+                del self._pending_swap[tenant]
+                for entry in reversed(self._history):
+                    if entry["tenant"] == tenant and not entry["swapped"]:
+                        entry["swapped"] = True
+                        entry["swap_ts"] = self._last_swap_ts
+                        break
+                self._save_state()
+
+    def _refresh(
+        self, pool, tenant: str, completed: int
+    ) -> Optional[dict]:
+        # Fresh stream per poll: resumable_request_log snapshots the
+        # segment set at construction, so a LIVE log is consumed as a
+        # sequence of seeked snapshots.
+        stream = SampleStream(
+            self.log_dir, self.filter,
+            state=self._positions.get(tenant),
+        )
+        self.filter.reset_dedup()
+        examples = stream.take(tenant)
+        position = stream.state()
+        if not examples:
+            # All new records filtered out (or sample capture off):
+            # mark them consumed so the meter delta re-arms instead of
+            # re-triggering on the same unusable records every poll.
+            self._consumed[tenant] = completed
+            self._positions[tenant] = position
+            return None
+        manager = None
+        if self.checkpoint_dir is not None:
+            from tpudl.ft.manager import AsyncCheckpointManager
+
+            manager = AsyncCheckpointManager(
+                os.path.join(self.checkpoint_dir, str(tenant))
+            )
+        try:
+            factors, info = self.trainer.refresh(
+                examples,
+                adapter=self._adapters.get(tenant),
+                tenant=tenant,
+                log_state=position,
+                manager=manager,
+            )
+        finally:
+            if manager is not None:
+                manager.close()
+        if factors is None:
+            # Preempted mid-refresh: the checkpoint holds factors +
+            # log position; the next poll re-enters refresh() and the
+            # manager resumes it schedule-identically. Nothing is
+            # marked consumed — the trigger stays armed.
+            return None
+        self._consumed[tenant] = completed
+        self._positions[tenant] = position
+        self._adapters[tenant] = factors
+        reg = obs_counters.registry()
+        reg.counter("flywheel_refreshes_total").inc()
+        reg.counter("flywheel_records_consumed_total").inc(
+            len(examples)
+        )
+        swapped = self._publish(pool, tenant, factors)
+        if not swapped:
+            self._pending_swap[tenant] = factors
+        losses = info.get("losses") or []
+        entry = {
+            "tenant": tenant,
+            "ts": self._clock(),
+            "records_consumed": len(examples),
+            "steps": info.get("steps", 0),
+            "loss_first": losses[0] if losses else None,
+            "loss_last": losses[-1] if losses else None,
+            "log_position": {
+                k: v for k, v in position.items()
+                if k in ("epoch", "offset")
+            },
+            "swapped": swapped,
+            "swap_ts": self._last_swap_ts if swapped else None,
+        }
+        self._history.append(entry)
+        return entry
+
+    def _publish(self, pool, tenant: str, factors: dict) -> bool:
+        """One register attempt under the safe-publish contract; False
+        = the tenant is leased right now (retry next poll)."""
+        try:
+            pool.register(tenant, factors, alpha=self.alpha)
+        except ValueError as e:
+            if "leased" in str(e):
+                return False
+            raise
+        self._last_swap_ts = self._clock()
+        return True
+
+    def _update_gauges(self) -> None:
+        if self._last_swap_ts is not None:
+            obs_counters.registry().gauge("flywheel_swap_age_s").set(
+                max(0.0, self._clock() - self._last_swap_ts)
+            )
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def history(self) -> List[dict]:
+        return list(self._history)
+
+    @property
+    def pending_swaps(self) -> List[str]:
+        return sorted(self._pending_swap)
+
+    def adapter(self, tenant: str) -> Optional[dict]:
+        """The latest refreshed factors for ``tenant`` (None before
+        its first refresh)."""
+        return self._adapters.get(tenant)
+
+    # -- the loop ------------------------------------------------------
+
+    def watch(self, stop=None, interval_s: Optional[float] = None):
+        """Poll forever (or until ``stop`` — a ``threading.Event`` or
+        any object with ``is_set()`` — fires) at
+        ``TPUDL_FLYWHEEL_INTERVAL_S`` cadence."""
+        if interval_s is None:
+            interval_s = interval_default()
+        while stop is None or not stop.is_set():
+            self.poll()
+            if stop is not None:
+                stop.wait(interval_s)
+            else:  # pragma: no cover - unbounded sleep loop
+                time.sleep(interval_s)

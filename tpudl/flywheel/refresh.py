@@ -1,0 +1,381 @@
+"""Per-tenant LoRA refresh training: frozen base, resumable mid-log.
+
+``RefreshTrainer`` is the flywheel's training half. Design points,
+each riding an existing seam rather than new machinery:
+
+- **LoRA factors only.** The train model is the serving config with
+  ``lora_rank`` set; the serving base params are GRAFTED into the
+  fresh init by path (f32 masters), and ``lora_optimizer`` freezes
+  everything but ``lora_a``/``lora_b`` (set_to_zero: no moments for
+  the frozen base — the tree is 99% frozen). The refreshed artifact
+  is ``extract_adapters(params)`` — exactly what
+  ``AdapterPool.register`` takes.
+- **Precision policy.** ``TPUDL_FLYWHEEL_PRECISION`` (default bf16)
+  resolves through ``tpudl.train.precision``; the step mirrors the
+  classification step's contract — cast-inside-loss, f32 reductions,
+  dynamic loss scaling with skip-on-nonfinite, and with the fp8
+  policy the train model's projection sites run Fp8Dense WITH the
+  adapter factors (the fp8 x LoRA cell this PR opens): amax rings
+  ride ``state.precision`` through checkpoints.
+- **Fixed shapes.** Examples pack to constant ``[B, L]`` batches
+  (``samples.pack_examples``) so one compiled step serves every
+  refresh — compiles happen once per trainer, never per refresh.
+- **Resumable mid-log.** Training drives ``tpudl.train.fit`` with an
+  ``ft.data.ResumableIterator`` whose ``state()`` carries the batch
+  position PLUS the tenant's request-log position; the
+  ``ft.AsyncCheckpointManager`` persists it as ``data_state`` next
+  to factors + optimizer + precision state. A PR 4 preemption
+  (SIGTERM grace) stops fit between steps, the emergency save
+  commits, and ``refresh()`` called again resumes schedule-identical
+  — bitwise the uninterrupted run (tests pin this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpudl.flywheel.samples import pack_examples
+from tpudl.ft.data import ResumableIterator
+from tpudl.models.lora import extract_adapters, lora_optimizer
+from tpudl.train import precision as precision_mod
+from tpudl.train.loop import TrainState, fit
+
+DEFAULT_BATCH_SIZE = 4
+DEFAULT_SEQ_LEN = 32
+DEFAULT_LEARNING_RATE = 5e-2
+DEFAULT_EPOCHS = 2
+
+
+def default_precision() -> str:
+    """The refresh policy preset (TPUDL_FLYWHEEL_PRECISION): bf16 by
+    default — the fp8 arm is opt-in per deployment."""
+    from tpudl.analysis.registry import env_str
+
+    return env_str("TPUDL_FLYWHEEL_PRECISION", "bf16")
+
+
+def _graft_base(init_params: Any, base_params: Any) -> Any:
+    """Init tree with every non-adapter leaf replaced by the serving
+    base value (cast to the init leaf's dtype — f32 masters stay f32
+    even when serving holds bf16). Adapter leaves keep their fresh
+    init (zero-B: the grafted model starts exactly at the base)."""
+
+    def walk(init_node, base_node):
+        if not isinstance(init_node, dict):
+            if base_node is None:
+                return init_node
+            return jnp.asarray(base_node, init_node.dtype)
+        out = {}
+        for key, value in init_node.items():
+            if key in ("lora_a", "lora_b"):
+                out[key] = value
+                continue
+            sub = (
+                base_node.get(key)
+                if isinstance(base_node, dict)
+                else None
+            )
+            out[key] = walk(value, sub)
+        return out
+
+    return walk(init_params, base_params)
+
+
+def _apply_adapter(params: Any, adapter: Dict[str, dict]) -> Any:
+    """Warm-start: write one tenant's extracted factors over the
+    fresh adapter leaves (site paths are '/'-joined module paths, the
+    ``extract_adapters`` form)."""
+    params = jax.tree.map(lambda x: x, params)
+    for path, factors in adapter.items():
+        node = params
+        for part in path.split("/"):
+            if part not in node:
+                raise ValueError(
+                    f"adapter site {path!r} not in the refresh model "
+                    f"(missing {part!r})"
+                )
+            node = node[part]
+        for leaf in ("lora_a", "lora_b"):
+            node[leaf] = jnp.asarray(
+                factors[leaf], node[leaf].dtype
+            )
+    return params
+
+
+class _RefreshData(ResumableIterator):
+    """Batch iterator whose ``state()`` also carries the request-log
+    position (and tenant) — the dict the checkpoint's ``data_state``
+    persists, and ``seek()`` still consumes (extra keys ignored)."""
+
+    def __init__(self, batches: List[dict], epochs: int, extra: dict):
+        super().__init__(lambda epoch: iter(batches), epochs=epochs)
+        self._extra = dict(extra)
+
+    def state(self) -> dict:
+        out = super().state()
+        out.update(self._extra)
+        return out
+
+
+class RefreshTrainer:
+    """One trainer per serving deployment: compiled once, refreshed
+    many (all tenants share the step — shapes and base are common;
+    only the grafted adapter differs per refresh)."""
+
+    def __init__(
+        self,
+        cfg: Any,
+        base_params: Any,
+        *,
+        rank: int = 2,
+        alpha: float = 16.0,
+        batch_size: int = DEFAULT_BATCH_SIZE,
+        seq_len: int = DEFAULT_SEQ_LEN,
+        learning_rate: float = DEFAULT_LEARNING_RATE,
+        precision: Any = None,
+        epochs: int = DEFAULT_EPOCHS,
+        seed: int = 0,
+    ):
+        from tpudl.models.llama import LlamaForCausalLM
+        from tpudl.models.lora import strip_adapters
+
+        if precision is None:
+            precision = default_precision()
+        self.policy = precision_mod.resolve_policy(precision)
+        self.rank = int(rank)
+        self.alpha = float(alpha)
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self.epochs = int(epochs)
+        train_cfg = dataclasses.replace(
+            cfg,
+            lora_rank=self.rank,
+            lora_alpha=self.alpha,
+            # The serving-only weight tier never trains.
+            weight_dtype=None,
+        )
+        if self.policy is not None:
+            if self.policy.use_fp8 and not train_cfg.fp8_train:
+                # The fp8 x LoRA cell: Fp8Dense carries the adapter
+                # factors, base matmuls run e4m3/e5m2 delayed scaling.
+                train_cfg = dataclasses.replace(
+                    train_cfg, fp8_train=True
+                )
+            train_cfg = self.policy.configure_model(train_cfg)
+        self.model = LlamaForCausalLM(train_cfg)
+        variables = self.model.init(
+            jax.random.key(seed),
+            jnp.zeros((self.batch_size, self.seq_len), jnp.int32),
+        )
+        self._fp8_template = variables.get("fp8")
+        self._params0 = _graft_base(
+            variables["params"], strip_adapters(base_params)
+        )
+        tx = lora_optimizer(
+            optax.adamw(learning_rate), self._params0
+        )
+        if self.policy is not None:
+            tx = precision_mod.apply_moment_rules(tx, self.policy)
+        self._tx = tx
+        self._step = jax.jit(self._build_step())
+
+    # -- state ---------------------------------------------------------
+
+    def init_state(
+        self, adapter: Optional[Dict[str, dict]] = None
+    ) -> TrainState:
+        """Fresh refresh state: grafted base + (optionally) the
+        tenant's current factors as the warm start."""
+        params = self._params0
+        if adapter:
+            params = _apply_adapter(params, adapter)
+        prec_state = None
+        if self.policy is not None:
+            prec_state = precision_mod.init_precision_state(
+                self.policy, self._fp8_template
+            )
+        return TrainState.create(
+            apply_fn=self.model.apply,
+            params=params,
+            batch_stats=None,
+            precision=prec_state,
+            tx=self._tx,
+        )
+
+    # -- the compiled step ---------------------------------------------
+
+    def _build_step(self):
+        policy = self.policy
+
+        def step(state, batch, rng):
+            del rng  # no dropout in the decoder; kept for fit()'s shape
+            tokens = batch["tokens"]
+            mask = batch["mask"]
+            prec = state.precision or {}
+            loss_scale = (
+                prec["loss_scale"]["scale"]
+                if policy is not None and policy.loss_scale is not None
+                else None
+            )
+            fp8_vars = (
+                prec.get("fp8")
+                if policy is not None and policy.use_fp8
+                else None
+            )
+
+            def loss_fn(params, fp8_vars=None):
+                run_params = (
+                    policy.cast_params(params)
+                    if policy is not None
+                    else params
+                )
+                variables = {"params": run_params}
+                if fp8_vars is not None:
+                    variables["fp8"] = fp8_vars
+                    logits, mutated = state.apply_fn(
+                        variables, tokens, mutable=["intermediates"]
+                    )
+                else:
+                    logits = state.apply_fn(variables, tokens)
+                    mutated = {}
+                logits = logits.astype(
+                    policy.reduce_dtype
+                    if policy is not None
+                    else jnp.float32
+                )
+                # Next-token CE on OUTPUT positions only: position t
+                # predicts token t+1, so weights shift with targets.
+                per = optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]
+                )
+                w = mask[:, 1:].astype(jnp.float32)
+                loss = jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+                objective = (
+                    loss if loss_scale is None else loss * loss_scale
+                )
+                return objective, (loss, mutated)
+
+            if fp8_vars is not None:
+                (
+                    (_, (loss, mutated)),
+                    (grads, fp8_grads),
+                ) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True
+                )(state.params, fp8_vars)
+            else:
+                (_, (loss, mutated)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params)
+                fp8_grads = None
+            if loss_scale is not None:
+                grads = jax.tree.map(lambda g: g / loss_scale, grads)
+
+            applied = state.apply_gradients(grads=grads)
+            metrics = {"loss": loss}
+            if policy is None:
+                return applied, metrics
+            new_prec = dict(prec)
+            if policy.loss_scale is not None:
+                ok = precision_mod.all_finite(grads)
+                new_state = precision_mod.select_tree(
+                    ok, applied, state
+                )
+                metrics["loss_scale"] = prec["loss_scale"]["scale"]
+                metrics["grad_skipped"] = jnp.where(ok, 0.0, 1.0)
+                new_prec["loss_scale"] = precision_mod.update_loss_scale(
+                    prec["loss_scale"], policy.loss_scale, ok
+                )
+            else:
+                ok = jnp.asarray(True)
+                new_state = applied
+            if policy.use_fp8 and fp8_vars is not None:
+                from tpudl.ops.fp8_dot import updated_fp8_state
+
+                new_prec["fp8"] = updated_fp8_state(
+                    prec["fp8"],
+                    mutated.get("intermediates", {}),
+                    fp8_grads,
+                    ok,
+                )
+            if new_prec:
+                new_state = new_state.replace(precision=new_prec)
+            return new_state, metrics
+
+        return step
+
+    # -- driving -------------------------------------------------------
+
+    def refresh(
+        self,
+        examples: List[dict],
+        *,
+        adapter: Optional[Dict[str, dict]] = None,
+        tenant: Any = None,
+        log_state: Optional[dict] = None,
+        manager: Any = None,
+        checkpoint_every: int = 1,
+        rng: Optional[jax.Array] = None,
+        max_steps: Optional[int] = None,
+    ) -> Tuple[Optional[Dict[str, dict]], dict]:
+        """Train the tenant's factors on ``examples``.
+
+        Returns ``(factors, info)``: the ``extract_adapters`` flat
+        tree ready for ``AdapterPool.register`` (None when preempted
+        before finishing — call again with the same ``manager`` to
+        resume schedule-identically), and an info dict with the loss
+        trajectory, step count, the consumed log position, and the
+        ``preempted`` flag."""
+        batches = pack_examples(
+            examples, self.batch_size, self.seq_len
+        )
+        if not batches:
+            return None, {
+                "steps": 0, "preempted": False, "losses": [],
+                "log_state": log_state, "tenant": tenant,
+            }
+        data = _RefreshData(
+            batches, self.epochs,
+            {"log": log_state, "tenant": tenant},
+        )
+        state = self.init_state(adapter)
+        if rng is None:
+            rng = jax.random.key(0)
+        resumed_from = None
+        if manager is not None and manager.latest_step() is not None:
+            state, saved_rng, data_state = manager.restore_full(state)
+            if saved_rng is not None:
+                rng = saved_rng
+            if data_state:
+                data.seek(data_state)
+                log_state = data_state.get("log", log_state)
+            resumed_from = int(state.step)
+
+        losses: List[float] = []
+
+        def collect(step_no, host_metrics):
+            losses.append(float(host_metrics["loss"]))
+
+        state, _, run_info = fit(
+            self._step, state, data, rng,
+            num_steps=max_steps,
+            log_every=1, logger=collect,
+            checkpoint_manager=manager,
+            checkpoint_every=checkpoint_every if manager else 0,
+        )
+        info = {
+            "steps": int(run_info["steps"]),
+            "total_steps": int(state.step),
+            "preempted": bool(run_info["preempted"]),
+            "resumed_from": resumed_from,
+            "losses": losses,
+            "log_state": log_state,
+            "tenant": tenant,
+        }
+        if run_info["preempted"]:
+            return None, info
+        return extract_adapters(state.params), info

@@ -1,0 +1,99 @@
+"""Sample records -> training examples -> fixed-shape batches.
+
+The request log's schema-v2 sample fields (``prompt_ids`` /
+``output_ids``, optional, behind ``TPUDL_OBS_REQUEST_LOG_SAMPLES``)
+are the flywheel's raw material. This module owns the two conversions
+every consumer shares:
+
+- ``example_from_record``: one durable-log record -> one training
+  example (``{"tenant", "prompt_ids", "output_ids"}``). Records
+  without samples (v1 records, or v2 written with capture off) are
+  NOT examples — ``has_sample`` is the gate the filter skips them
+  loudly through.
+- ``pack_examples``: examples -> fixed ``[B, L]`` token/mask batches.
+  FIXED shapes are the zero-recompile contract: every refresh batch
+  (including ragged tails, padded with mask-0 rows) runs the one
+  compiled train step, exactly like the serving engine's static slot
+  shapes. The mask marks OUTPUT positions only — the refresh loss
+  teaches the adapter the served completions, not the prompts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def has_sample(record: dict) -> bool:
+    """Whether a request-log record carries the v2 sample fields with
+    actual content (an empty output trains nothing)."""
+    return bool(record.get("prompt_ids")) and bool(
+        record.get("output_ids")
+    )
+
+
+def example_from_record(record: dict) -> Optional[Dict]:
+    """The training example a sample-carrying record yields, or None
+    when the record has no sample (the version contract: consumers
+    ignore what a record doesn't carry — the filter counts these)."""
+    if not has_sample(record):
+        return None
+    return {
+        "tenant": record.get("tenant"),
+        "prompt_ids": [int(t) for t in record["prompt_ids"]],
+        "output_ids": [int(t) for t in record["output_ids"]],
+    }
+
+
+def pack_examples(
+    examples: List[dict],
+    batch_size: int,
+    seq_len: int,
+) -> List[Dict[str, np.ndarray]]:
+    """Pack examples into fixed-shape ``{"tokens": [B, L] int32,
+    "mask": [B, L] float32}`` batches.
+
+    Each row is ``prompt + output`` right-truncated to L (keeping the
+    prompt tail — the tokens that condition the first outputs) and
+    zero-padded; mask is 1.0 exactly on output positions that
+    survived the truncation. A ragged final batch pads with all-zero
+    mask-0 rows, so every batch has the SAME shape and the masked
+    loss weights the padding out — the trainer never recompiles on
+    the tail."""
+    if batch_size < 1 or seq_len < 2:
+        raise ValueError(
+            f"need batch_size >= 1 and seq_len >= 2, got "
+            f"({batch_size}, {seq_len})"
+        )
+    rows = []
+    for ex in examples:
+        prompt = list(ex["prompt_ids"])
+        output = list(ex["output_ids"])
+        if not output:
+            continue
+        # Right-truncate from the LEFT of the prompt: the loss lives
+        # on output positions, which need their conditioning context
+        # more than the prompt's distant head.
+        keep_prompt = max(1, seq_len - len(output))
+        prompt = prompt[-keep_prompt:]
+        tokens = (prompt + output)[:seq_len]
+        mask = ([0.0] * len(prompt) + [1.0] * len(output))[:seq_len]
+        pad = seq_len - len(tokens)
+        tokens = tokens + [0] * pad
+        mask = mask + [0.0] * pad
+        rows.append((tokens, mask))
+    batches = []
+    for i in range(0, len(rows), batch_size):
+        chunk = rows[i:i + batch_size]
+        while len(chunk) < batch_size:
+            chunk.append(([0] * seq_len, [0.0] * seq_len))
+        batches.append({
+            "tokens": np.asarray(
+                [t for t, _ in chunk], np.int32
+            ),
+            "mask": np.asarray(
+                [m for _, m in chunk], np.float32
+            ),
+        })
+    return batches

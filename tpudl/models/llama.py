@@ -76,7 +76,9 @@ class LlamaConfig:
     # per-block projections) through Fp8Dense (e4m3 fwd / e5m2 grad,
     # delayed scaling; f32 master params, nn.Dense-identical tree).
     # "force"/"fused"/"reference" pin the fp8_dot impl seam. Mutually
-    # exclusive with weight_dtype and lora_rank.
+    # exclusive with weight_dtype; COMPOSES with lora_rank (fp8 base
+    # matmul + full-precision rank-r adapters — the flywheel refresh's
+    # cheapest training cell).
     fp8_train: Any = False
     # MoE (tpudl.ops.moe): >0 swaps the dense SwiGLU MLP for an
     # expert-parallel gated MoE in every block.
@@ -132,14 +134,17 @@ def _proj(cfg: LlamaConfig, features: int, name: str):
     shape. Adapter leaves fall under the quantizer's keep-all rule, so
     quantize_model on a LoRA tree quantizes only the base kernels.
     ``fp8_train`` (training-time fp8 matmuls, tpudl.ops.fp8_dot) swaps
-    the same sites to Fp8Dense instead — exclusive with both serving
-    quantization and adapters."""
+    the same sites to Fp8Dense instead — exclusive with serving
+    quantization, but it COMPOSES with ``lora_rank``: Fp8Dense carries
+    the same ``lora_a``/``lora_b`` leaves as LoRADense (full-precision
+    delta over the fp8 base product), so the frozen-base optimizer and
+    adapter extraction seams see an identical tree shape."""
     if cfg.fp8_train:
-        if cfg.weight_dtype is not None or cfg.lora_rank > 0:
+        if cfg.weight_dtype is not None:
             raise ValueError(
                 "fp8_train (training-time fp8 matmuls) does not compose "
                 "with weight_dtype (frozen-tree serving quantization) "
-                "or lora_rank — pick one"
+                "— pick one"
             )
         from tpudl.ops.fp8_dot import Fp8Dense
 
@@ -152,6 +157,8 @@ def _proj(cfg: LlamaConfig, features: int, name: str):
             dtype=cfg.dtype,
             kernel_init=nn.initializers.normal(0.02),
             impl=impl,
+            rank=cfg.lora_rank,
+            alpha=cfg.lora_alpha,
             name=name,
         )
     if cfg.weight_dtype is not None and cfg.lora_rank == 0:

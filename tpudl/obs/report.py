@@ -1042,6 +1042,111 @@ def format_tenant_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def load_flywheel_state(paths: Iterable[str]) -> dict:
+    """The persisted ``FlywheelController`` state
+    (``flywheel-state.json``, written next to the request-log
+    segments) from the first path that holds one — paths follow the
+    ``--tenants`` convention (a request-log directory, or a run dir
+    with a ``requestlog/`` subdir)."""
+    from tpudl.flywheel.loop import STATE_FILENAME
+
+    for p in paths:
+        for d in (p, os.path.join(p, "requestlog")):
+            f = os.path.join(d, STATE_FILENAME)
+            if os.path.isfile(f):
+                with open(f, "r", encoding="utf-8") as fh:
+                    return json.load(fh)
+    raise FileNotFoundError(
+        f"no flywheel-state.json under {list(paths)} — has a "
+        f"FlywheelController run against this request log?"
+    )
+
+
+def build_flywheel_report(state: dict) -> dict:
+    """Per-tenant refresh rollup over the controller's persisted
+    history: refresh count, records consumed, the last consumed log
+    position, last swap time, and the last refresh's loss delta."""
+    tenants: Dict[str, dict] = {}
+    for entry in state.get("history", ()):
+        t = str(entry.get("tenant"))
+        row = tenants.setdefault(t, {
+            "refreshes": 0,
+            "records_consumed": 0,
+            "steps": 0,
+            "log_position": None,
+            "last_swap_ts": None,
+            "loss_first": None,
+            "loss_last": None,
+            "pending_swap": False,
+        })
+        row["refreshes"] += 1
+        row["records_consumed"] += int(entry.get("records_consumed", 0))
+        row["steps"] += int(entry.get("steps", 0))
+        row["log_position"] = entry.get("log_position")
+        row["loss_first"] = entry.get("loss_first")
+        row["loss_last"] = entry.get("loss_last")
+        if entry.get("swapped"):
+            row["last_swap_ts"] = entry.get("swap_ts")
+            row["pending_swap"] = False
+        else:
+            row["pending_swap"] = True
+    for t, pos in state.get("positions", {}).items():
+        tenants.setdefault(str(t), {
+            "refreshes": 0, "records_consumed": 0, "steps": 0,
+            "log_position": None, "last_swap_ts": None,
+            "loss_first": None, "loss_last": None,
+            "pending_swap": False,
+        })["log_position"] = {
+            k: v for k, v in pos.items() if k in ("epoch", "offset")
+        }
+    return {
+        "tenants": tenants,
+        "total_refreshes": sum(
+            r["refreshes"] for r in tenants.values()
+        ),
+        "last_swap_ts": state.get("last_swap_ts"),
+    }
+
+
+def format_flywheel_report(report: dict) -> str:
+    import datetime
+
+    def when(ts):
+        if ts is None:
+            return "—"
+        return datetime.datetime.fromtimestamp(ts).strftime(
+            "%Y-%m-%d %H:%M:%S"
+        )
+
+    lines = [
+        f"flywheel refreshes: {report['total_refreshes']}  "
+        f"last swap: {when(report['last_swap_ts'])}",
+        "",
+        f"{'tenant':<16} {'refreshes':>9} {'records':>8} {'steps':>6} "
+        f"{'log_pos':>12} {'loss_delta':>11} {'last_swap':>20}",
+    ]
+    for tenant in sorted(report["tenants"]):
+        r = report["tenants"][tenant]
+        pos = r["log_position"] or {}
+        pos_s = (
+            f"{pos.get('epoch', '?')}:{pos.get('offset', '?')}"
+            if pos else "—"
+        )
+        if r["loss_first"] is not None and r["loss_last"] is not None:
+            delta = f"{r['loss_last'] - r['loss_first']:+11.4f}"
+        else:
+            delta = f"{'—':>11}"
+        swap = when(r["last_swap_ts"]) + (
+            " (pending)" if r["pending_swap"] else ""
+        )
+        lines.append(
+            f"{tenant:<16} {r['refreshes']:>9} "
+            f"{r['records_consumed']:>8} {r['steps']:>6} "
+            f"{pos_s:>12} {delta} {swap:>20}"
+        )
+    return "\n".join(lines)
+
+
 def format_request_record(rec: dict) -> str:
     """Render one durable terminal record — the ``--request`` answer
     when the span stream no longer exists (no per-hop timeline, but
@@ -1110,9 +1215,25 @@ def main(argv: Optional[list] = None) -> int:
                     "from durable request-log records (paths are "
                     "request-log directories or run dirs holding a "
                     "requestlog/ subdir) instead of the span report")
+    ap.add_argument("--flywheel", action="store_true",
+                    help="print the per-tenant continual-refresh "
+                    "history (records consumed, log position, last "
+                    "swap, loss delta) from the FlywheelController's "
+                    "flywheel-state.json next to the request log")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.flywheel:
+        try:
+            fly_state = load_flywheel_state(args.paths)
+        except FileNotFoundError as e:
+            print(e)
+            return 1
+        fly = build_flywheel_report(fly_state)
+        print(
+            json.dumps(fly) if args.json else format_flywheel_report(fly)
+        )
+        return 0
     if args.tenants:
         # The durable log, not the span stream: --tenants answers
         # "who consumed which chips" after the serving processes (and

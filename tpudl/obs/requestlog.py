@@ -63,7 +63,11 @@ from tpudl.obs.counters import registry
 #: consumers accept records with ``v <= SCHEMA_VERSION`` and IGNORE
 #: unknown fields; producers only ever ADD fields within a version and
 #: bump the version when a field's meaning changes or disappears.
-SCHEMA_VERSION = 1
+#: v2 adds OPTIONAL ``prompt_ids``/``output_ids`` sample fields
+#: (present only when TPUDL_OBS_REQUEST_LOG_SAMPLES capture is on —
+#: the tpudl.flywheel training source); v1 records stay readable and
+#: sample consumers skip them loudly (tpudl.flywheel.filter).
+SCHEMA_VERSION = 2
 
 _PREFIX = "requests-"
 _OPEN_SUFFIX = ".open.jsonl"
@@ -528,12 +532,17 @@ def build_record(
     tpot_s: Optional[float] = None,
     active_s: float = 0.0,
     ts: Optional[float] = None,
+    prompt_ids: Optional[List[int]] = None,
+    output_ids: Optional[List[int]] = None,
 ) -> dict:
-    """One schema-v1 record. ``active_s`` is the slot-occupancy wall
+    """One schema record. ``active_s`` is the slot-occupancy wall
     time (seat -> last token): the chip-seconds numerator of the
     cost-attribution table and, for tenant-ful requests, the adapter
-    residency."""
-    return {
+    residency. ``prompt_ids``/``output_ids`` are the v2 OPTIONAL
+    sample fields — only present when the caller passes them (the
+    engine does so iff ``samples_enabled()``), so sample-less v2
+    records stay byte-shaped like v1 plus the version stamp."""
+    record = {
         "v": SCHEMA_VERSION,
         "ts": time.time() if ts is None else ts,
         "request_id": request_id,
@@ -554,6 +563,37 @@ def build_record(
         "tpot_s": tpot_s,
         "active_s": float(active_s),
     }
+    if prompt_ids is not None:
+        record["prompt_ids"] = [int(t) for t in prompt_ids]
+    if output_ids is not None:
+        record["output_ids"] = [int(t) for t in output_ids]
+    return record
+
+
+#: Programmatic override of the sample-capture knob (None = defer to
+#: the env): the embedding surface for benches/hosts that toggle
+#: capture per run without mutating ``os.environ``.
+_samples_override: Optional[bool] = None
+
+
+def set_samples_capture(value: Optional[bool]) -> None:
+    """Force sample capture on/off for this process (``None`` restores
+    the TPUDL_OBS_REQUEST_LOG_SAMPLES env knob's say)."""
+    global _samples_override
+    _samples_override = None if value is None else bool(value)
+
+
+def samples_enabled() -> bool:
+    """Whether completed results should carry ``prompt_ids`` /
+    ``output_ids`` (the TPUDL_OBS_REQUEST_LOG_SAMPLES knob, unless
+    ``set_samples_capture`` overrode it). Token ids
+    are user content — capture is opt-in and separate from the metrics
+    log, so operators can meter traffic without retaining prompts."""
+    if _samples_override is not None:
+        return _samples_override
+    from tpudl.analysis.registry import env_flag
+
+    return env_flag("TPUDL_OBS_REQUEST_LOG_SAMPLES")
 
 
 _active: Optional[RequestLogWriter] = None

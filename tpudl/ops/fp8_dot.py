@@ -217,6 +217,15 @@ class Fp8Dense(nn.Module):
     recompile. The step reads each site's new forward amaxes from the
     ``"intermediates"`` sow (key ``fp8_fwd``) and the gradient amax
     from the fp8 collection's cotangents.
+
+    ``rank > 0`` adds LoRA factors over the fp8 base matmul — the same
+    ``lora_a``/``lora_b`` leaves (and zero-init-B contract) as
+    tpudl.models.lora.LoRADense, so ``extract_adapters`` /
+    ``lora_optimizer`` / ``LORA_RULES`` apply unchanged. The adapter
+    delta runs FULL precision on top of the quantized base product
+    (the fp8-base + high-precision-adapters fine-tune shape): the
+    factors are rank-r slivers, so skipping the fp8 cast costs nothing
+    while keeping the trainable path's numerics clean.
     """
 
     features: int
@@ -226,6 +235,8 @@ class Fp8Dense(nn.Module):
     bias_init: Callable = nn.initializers.zeros_init()
     amax_window: Optional[int] = None
     impl: str = "auto"
+    rank: int = 0
+    alpha: float = 16.0
 
     @nn.compact
     def __call__(self, x):
@@ -265,6 +276,19 @@ class Fp8Dense(nn.Module):
             "intermediates", "fp8_fwd",
             {"x_amax": x_amax, "w_amax": w_amax},
         )
+        if self.rank > 0:
+            lora_a = self.param(
+                "lora_a",
+                nn.initializers.normal(1.0 / self.rank),
+                (x.shape[-1], self.rank),
+            )
+            lora_b = self.param(
+                "lora_b", nn.initializers.zeros, (self.rank, self.features)
+            )
+            out = out + jnp.dot(
+                jnp.dot(x, lora_a.astype(x.dtype)),
+                lora_b.astype(x.dtype),
+            ) * (self.alpha / self.rank)
         if bias is not None:
             out = out + bias
         return out

@@ -1154,6 +1154,15 @@ class Engine:
             kv_byte_s = kv_page_s * (
                 self.cache.nbytes / max(1, self.cache.num_pages)
             )
+        # Sample capture (schema v2, opt-in): token ids ride ONLY on
+        # completed results from this site — sheds/failures never carry
+        # user content into the durable log.
+        samples = {}
+        if requestlog.samples_enabled():
+            samples = {
+                "prompt_ids": list(req.input_ids),
+                "output_ids": list(s.tokens),
+            }
         requestlog.log_result(requestlog.build_record(
             req.request_id, reason, site="engine",
             tenant=getattr(req, "tenant", None),
@@ -1164,6 +1173,7 @@ class Engine:
             adapter_reloads=s.adapter_reloads, migrations=s.migrations,
             queue_wait_s=queue_wait, ttft_s=ttft, tpot_s=tpot,
             active_s=active_s,
+            **samples,
         ))
         self.cache.free(slot)
         if self.speculator is not None:

@@ -173,8 +173,13 @@ def make_classification_train_step(
     cotangent), and ride out on the returned state. Reported metrics
     gain ``loss_scale`` / ``grad_skipped`` when scaling is on; the
     ``loss`` metric is always the UNSCALED loss. fp8 composes with
-    everything except gradient accumulation (``accum_steps > 1``
-    raises — the amax rings would need per-microbatch threading).
+    gradient accumulation too: each site's per-microbatch amax
+    observations combine by elementwise max through the scan carry —
+    the forward amaxes of the microbatches partition the full batch,
+    so their max IS the monolithic step's amax, and the ring advances
+    once per optimizer step exactly as at ``accum_steps=1``
+    (tests/test_precision.py holds the accum-vs-monolithic fp8 loss
+    trajectory to the fp8 parity band).
 
     ``loss_impl`` routes the cross-entropy through the
     tpudl.ops.cross_entropy dispatch seam ("reference" = the optax
@@ -228,13 +233,6 @@ def make_classification_train_step(
     from tpudl.train import precision as precision_mod
 
     policy = precision_mod.resolve_policy(precision)
-    if policy is not None and policy.use_fp8 and accum_steps > 1:
-        raise ValueError(
-            "precision policy 'fp8' does not compose with gradient "
-            "accumulation yet (the per-site amax rings would need to "
-            "thread through the microbatch scan) — use accum_steps=1 "
-            "or the bf16 policy"
-        )
     # None = auto (env knob, else default-on-multi-shard); an explicit
     # 0 disables — mapped to 0 bytes, which accumulate() treats as off.
     overlap_bucket_bytes = (
@@ -405,9 +403,9 @@ def make_classification_train_step(
             micro = microbatch(batch, accum_steps)
 
             def body(carry, xs):
-                grads_acc, stats, metrics_acc = carry
+                grads_acc, stats, metrics_acc, prec_acc = carry
                 mb, a = xs
-                grads, metrics, new_stats, _ = _grads_and_metrics(
+                grads, metrics, new_stats, prec_aux = _grads_and_metrics(
                     state, state.params, stats,
                     mb, jax.random.fold_in(step_rng, a),
                 )
@@ -415,7 +413,14 @@ def make_classification_train_step(
                     grads_acc, grads, bucket_bytes=overlap_bucket_bytes
                 )
                 metrics_acc = jax.tree.map(jnp.add, metrics_acc, metrics)
-                return (grads_acc, new_stats, metrics_acc), None
+                # fp8 amax observations combine by MAX, not sum: every
+                # leaf is a max-|value| reduction (forward amaxes sown
+                # per site, the g_probe cotangent; the hist cotangents
+                # are structural zeros), all >= 0 — so a zeros carry
+                # is the identity and the combined tree is exactly the
+                # monolithic step's observation for forward sites.
+                prec_acc = jax.tree.map(jnp.maximum, prec_acc, prec_aux)
+                return (grads_acc, new_stats, metrics_acc, prec_acc), None
 
             # All microbatches run inside the one scan (a single copy of
             # the layer graph in the executable — unrolling microbatch 0
@@ -424,20 +429,22 @@ def make_classification_train_step(
             # executing. BatchNorm stats thread through the carry,
             # updating per microbatch sequentially.
             mb0 = {k: v[0] for k, v in micro.items()}
-            _, m_shape, _, _ = jax.eval_shape(
+            _, m_shape, _, aux_shape = jax.eval_shape(
                 lambda s, b, r: _grads_and_metrics(
                     state, state.params, s, b, r
                 ),
                 state.batch_stats, mb0, step_rng,
             )
+            zeros_of = lambda sh: jnp.zeros(sh.shape, sh.dtype)  # noqa: E731
             carry0 = (
                 jax.tree.map(jnp.zeros_like, state.params),
                 state.batch_stats,
-                jax.tree.map(
-                    lambda sh: jnp.zeros(sh.shape, sh.dtype), m_shape
-                ),
+                jax.tree.map(zeros_of, m_shape),
+                # None (no fp8 policy) stays None through the scan;
+                # under fp8 the zeros tree is the max-combine identity.
+                jax.tree.map(zeros_of, aux_shape),
             )
-            (grads, new_stats, metrics), _ = jax.lax.scan(
+            (grads, new_stats, metrics, prec_aux), _ = jax.lax.scan(
                 body, carry0, (micro, jnp.arange(accum_steps))
             )
             # Equal-sized microbatches: mean of per-microbatch means is
@@ -445,7 +452,6 @@ def make_classification_train_step(
             # metrics divide by the microbatch count.
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             metrics = jax.tree.map(lambda m: m / accum_steps, metrics)
-            prec_aux = None
         if policy is not None:
             return _finish_policy_step(
                 state, grads, metrics, new_stats, prec_aux
