@@ -20,6 +20,7 @@ buffering.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -472,6 +473,32 @@ def _bench_fleet():
     return measure_fleet()
 
 
+def _bench_fleet_mesh():
+    """Pod-real fleet tier (tpudl.fleet via benchmarks/fleet_mesh.py):
+    elastic reshard-restore wall time (4-device checkpoint onto an
+    8-device mesh), routed throughput over two 4-device MeshReplicas,
+    and the chip mover's burn-to-cleared time for the full
+    preempt -> shrink -> serve -> drain -> grow scenario. Runs as a
+    subprocess: the forced host-device count must be set before jax
+    imports, which this process has long since done."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.fleet_mesh", "--json"],
+        capture_output=True, text=True, timeout=1800, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet_mesh subprocess failed rc={proc.returncode}:\n"
+            f"{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def _bench_parity_grid():
     """Low-precision serving grid (benchmarks/parity_grid.py): every
     precision x backend cell parity-gated against the f32 reference,
@@ -761,6 +788,15 @@ def main(argv=None):
         traceback.print_exc()
         ft = {}
     try:
+        fleet_mesh = _bench_fleet_mesh()
+    except Exception:
+        import sys
+        import traceback
+
+        print("fleet mesh bench failed:", file=sys.stderr)
+        traceback.print_exc()
+        fleet_mesh = {}
+    try:
         parity_grid = _bench_parity_grid()
     except Exception:
         import sys
@@ -980,6 +1016,26 @@ def main(argv=None):
         ),
         "flywheel_serving_p99_impact_ratio": flywheel.get(
             "flywheel_serving_p99_impact_ratio"
+        ),
+        # Pod-real fleet tier (tpudl.fleet via benchmarks/
+        # fleet_mesh.py, subprocess): elastic reshard-restore wall
+        # time for a 4-device checkpoint onto an 8-device mesh (the
+        # payload MB rides for the bytes model), routed tokens/sec
+        # over two 4-device tensor-parallel MeshReplicas, and the
+        # chip mover's burn-to-cleared time across the full
+        # preempt -> shrink -> serve -> drain -> grow scenario
+        # (zero dropped results asserted inside the benchmark).
+        "fleet_reshard_restore_s": fleet_mesh.get(
+            "fleet_reshard_restore_s"
+        ),
+        "fleet_reshard_payload_mb": fleet_mesh.get(
+            "fleet_reshard_payload_mb"
+        ),
+        "serve_tokens_per_sec_2mesh": fleet_mesh.get(
+            "serve_tokens_per_sec_2mesh"
+        ),
+        "chipmover_burn_cleared_s": fleet_mesh.get(
+            "chipmover_burn_cleared_s"
         ),
         # Fault tolerance (tpudl.ft via benchmarks/
         # ft_recovery.py): the async checkpoint's mean on-step

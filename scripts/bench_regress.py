@@ -149,6 +149,18 @@ NOISE_BAND_FLOORS = {
     # inherits the same wide band.
     "flywheel_refresh_latency_s": 0.60,
     "flywheel_serving_p99_impact_ratio": 0.50,
+    # Pod-real fleet keys (benchmarks/fleet_mesh.py subprocess, banked
+    # from r19). Reshard-restore is host-array device_put over 8 fake
+    # devices on 1 vCPU (scheduler-owned); the payload MB is pure
+    # arithmetic (drift = the template changed); the 2-mesh routed
+    # throughput rides emulated collectives + thread hand-offs, wider
+    # than the 2rep thread-replica band; burn-cleared wall time is
+    # dominated by the borrowed replica's serving-program compiles,
+    # which vary with XLA's own scheduling on a loaded host.
+    "fleet_reshard_restore_s": 0.60,
+    "fleet_reshard_payload_mb": 0.05,
+    "serve_tokens_per_sec_2mesh": 0.30,
+    "chipmover_burn_cleared_s": 0.60,
 }
 DEFAULT_BAND_FLOOR = 0.08
 
@@ -172,6 +184,8 @@ LOWER_IS_BETTER = {
     "requestlog_bytes_per_request",
     "flywheel_refresh_latency_s",
     "flywheel_serving_p99_impact_ratio",
+    "fleet_reshard_restore_s",
+    "chipmover_burn_cleared_s",
 }
 
 #: Lower-is-better metrics whose banked baseline is 0 and must STAY 0:

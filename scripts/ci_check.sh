@@ -48,6 +48,10 @@ echo "== flywheel smoke (samples on -> one LoRA refresh -> safe hot-swap asserte
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmarks.serve_load \
     --flywheel --requests 8 > /dev/null
 
+echo "== fleet smoke (mesh replicas + reshard-restore + chip mover end-to-end)"
+JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m benchmarks.fleet_mesh \
+    --smoke --json > /dev/null
+
 echo "== chaos smoke (serving fault injection: migration, failover, drains)"
 JAX_PLATFORMS=${JAX_PLATFORMS:-cpu} python -m pytest tests/ -q -m 'chaos and not slow' \
     -p no:cacheprovider

@@ -639,6 +639,107 @@ def test_controller_state_persists_and_report_renders(
 
 
 # ---------------------------------------------------------------------------
+# the promotion gate: held-out eval before register, roll back on fail
+# ---------------------------------------------------------------------------
+
+
+class _GateStubTrainer(_StubTrainer):
+    """A stub WITH ``evaluate`` — its presence arms the gate. Scores
+    are scripted per side: the refreshed factors score ``new``, the
+    prior adapter (or base, when None) scores ``prior``."""
+
+    def __init__(self, factors, new=1.0, prior=2.0):
+        super().__init__(factors)
+        self.scores = {"new": new, "prior": prior}
+        self.eval_calls = []
+
+    def evaluate(self, examples, adapter=None):
+        side = "new" if adapter is self.factors else "prior"
+        self.eval_calls.append((len(examples), side))
+        return self.scores[side]
+
+
+def test_gate_holds_out_tail_and_promotes_on_pass(base, tmp_path):
+    pool = _make_pool(base, make_adapter(base, seed=1))
+    stub = _GateStubTrainer(make_adapter(base, seed=2), new=1.0, prior=2.0)
+    ctl = FlywheelController(
+        _StubSession(pool), str(tmp_path), stub, min_records=4,
+        holdout_frac=0.25,
+    )
+    _fill_log_and_meter(str(tmp_path), 8)
+    entries = ctl.poll()
+    assert len(entries) == 1
+    gate = entries[0]["gate"]
+    assert gate is not None and gate["passed"] is True
+    assert gate["held_out_new"] == 1.0
+    assert gate["held_out_prior"] == 2.0
+    assert gate["holdout_records"] == 2  # round(8 * 0.25)
+    # The held-out tail never reached training.
+    assert stub.calls == [(6, "t0")]
+    assert {n for n, _ in stub.eval_calls} == {2}
+    assert entries[0]["swapped"] is True
+    reg = obs_counters.registry()
+    assert reg.counter("flywheel_promotions_rejected").value == 0
+
+
+def test_gate_rejects_worse_factors_and_rolls_back(base, tmp_path):
+    pool = _make_pool(base, make_adapter(base, seed=1))
+    stub = _GateStubTrainer(make_adapter(base, seed=2), new=3.0, prior=2.0)
+    ctl = FlywheelController(
+        _StubSession(pool), str(tmp_path), stub, min_records=4,
+        holdout_frac=0.25,
+    )
+    _fill_log_and_meter(str(tmp_path), 8)
+    entries = ctl.poll()
+    assert len(entries) == 1
+    assert entries[0]["rejected"] is True
+    assert entries[0]["swapped"] is False
+    assert entries[0]["gate"]["passed"] is False
+    reg = obs_counters.registry()
+    assert reg.counter("flywheel_promotions_rejected").value == 1
+    # Rolled back but CONSUMED: the same rejected samples must not
+    # retrigger a refresh loop at the next poll.
+    assert ctl.poll() == []
+    assert len(stub.calls) == 1
+    # Fresh traffic + a trainer that now produces good factors -> the
+    # flywheel recovers on its own.
+    stub.scores["new"] = 1.5
+    _fill_log_and_meter(str(tmp_path), 4, start=8)
+    entries = ctl.poll()
+    assert entries and entries[0]["gate"]["passed"] is True
+    assert entries[0]["swapped"] is True
+    assert reg.counter("flywheel_promotions_rejected").value == 1
+
+
+def test_gate_tolerance_and_disable(base, tmp_path):
+    # Within gate_tol: slightly-worse held-out loss still promotes
+    # (the knob absorbs eval noise on small holdouts).
+    pool = _make_pool(base, make_adapter(base, seed=1))
+    stub = _GateStubTrainer(make_adapter(base, seed=2), new=2.1, prior=2.0)
+    ctl = FlywheelController(
+        _StubSession(pool), str(tmp_path), stub, min_records=4,
+        holdout_frac=0.25, gate_tol=0.5,
+    )
+    _fill_log_and_meter(str(tmp_path), 8)
+    entries = ctl.poll()
+    assert entries[0]["gate"]["passed"] is True and entries[0]["swapped"]
+    # holdout_frac=0 disables the gate entirely: all records train.
+    pool2 = _make_pool(base, make_adapter(base, seed=3))
+    stub2 = _GateStubTrainer(make_adapter(base, seed=4), new=9.0, prior=1.0)
+    d2 = os.path.join(str(tmp_path), "nogate")
+    os.makedirs(d2)
+    ctl2 = FlywheelController(
+        _StubSession(pool2), d2, stub2, min_records=4, holdout_frac=0.0,
+    )
+    _fill_log_and_meter(d2, 8)
+    entries = ctl2.poll()
+    assert entries[0]["gate"] is None
+    assert entries[0]["swapped"] is True
+    assert stub2.calls == [(8, "t0")]
+    assert stub2.eval_calls == []
+
+
+# ---------------------------------------------------------------------------
 # e2e acceptance: serve -> log -> filter -> refresh -> hot-swap
 # ---------------------------------------------------------------------------
 

@@ -237,6 +237,16 @@ _declare("TPUDL_FLYWHEEL_PRECISION", "str", "bf16",
          "RefreshTrainer precision policy preset (f32 | bf16 | fp8); "
          "fp8 opens the fp8-base x LoRA-factor training cell.",
          "tpudl.flywheel.refresh")
+_declare("TPUDL_FLYWHEEL_HOLDOUT_FRAC", "float", 0.25,
+         "Fraction of each refresh's sample stream held OUT of "
+         "training and used as the promotion gate's eval slice "
+         "(0 disables the gate).",
+         "tpudl.flywheel.loop")
+_declare("TPUDL_FLYWHEEL_GATE_TOL", "float", 0.0,
+         "Promotion gate tolerance: refreshed factors publish only if "
+         "held-out loss <= prior-factor loss + tol; failures roll "
+         "back to the prior adapter.",
+         "tpudl.flywheel.loop")
 
 # --- fault tolerance / chaos --------------------------------------------
 _declare("TPUDL_FT_GRACE_S", "float", 15.0,
@@ -300,6 +310,35 @@ _declare("TPUDL_SERVE_CHAOS_FLIP_MIGRATION", "flag", False,
          "transfer — the crc must catch it and shed the request as "
          "failed, never resume it.",
          "tpudl.serve.chaos")
+
+# --- fleet (pod-real meshes / chip mover) --------------------------------
+_declare("TPUDL_FLEET_TRANSPORT_HOST", "str", None,
+         "Bind/connect host for cross-process MigrationEndpoints "
+         "(unset = 127.0.0.1).",
+         "tpudl.fleet.transport")
+_declare("TPUDL_FLEET_TRANSPORT_TIMEOUT_S", "float", 30.0,
+         "Socket send/recv timeout for migration transfers.",
+         "tpudl.fleet.transport")
+_declare("TPUDL_FLEET_SPOOL_DIR", "path", None,
+         "Default directory for FileChannel() spool-file migration "
+         "(shared-filesystem transport).",
+         "tpudl.fleet.transport")
+_declare("TPUDL_FLEET_BURN_SUSTAIN_S", "float", 2.0,
+         "How long SLO burn must persist before the chip mover "
+         "preempts training and lends devices to serving.",
+         "tpudl.fleet.chipmover")
+_declare("TPUDL_FLEET_CLEAR_SUSTAIN_S", "float", 5.0,
+         "How long burn must stay clear before borrowed devices "
+         "drain back to training.",
+         "tpudl.fleet.chipmover")
+_declare("TPUDL_FLEET_COOLDOWN_S", "float", 2.0,
+         "Minimum gap between chip moves (flap damping, the "
+         "Autoscaler's cooldown applied to device moves).",
+         "tpudl.fleet.chipmover")
+_declare("TPUDL_FLEET_SERVE_SHARE", "float", 0.5,
+         "Fraction of the training cohort's devices a move lends to "
+         "the borrowed serving replica (training keeps >= 1).",
+         "tpudl.fleet.chipmover")
 
 # --- analysis ------------------------------------------------------------
 _declare("TPUDL_DEBUG_LOCK_ORDER", "flag", False,

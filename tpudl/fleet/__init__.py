@@ -1,0 +1,62 @@
+"""tpudl.fleet: pod-real replica meshes, cross-process migration
+transport, and elastic reshard-restore (the chip mover).
+
+Everything earlier PRs shipped treats a "replica" as a driver thread
+over one local device view. This package is the placement/transport/
+restore layer that makes replicas *meshes* and cohorts *elastic*:
+
+- ``meshrep``   — ``MeshReplica``: a serving replica whose compiled
+  programs are pjit-sharded over a tensor-parallel device mesh. The
+  Router places over mesh replicas exactly as it placed over thread
+  replicas (the mesh sits BELOW the placement contract).
+- ``transport`` — the PR 13 migration payload (paged KV + tokens +
+  sampling position + absolute deadline, plus the speculative draft
+  remainder) shipped over a socket or spool-file channel, so failover
+  crosses a process boundary instead of a thread boundary.
+- ``reshard``   — elastic restore: a checkpoint written on one mesh
+  shape restores onto a *different* shape (coverage-checked rules +
+  ``AsyncCheckpointManager.restore_full``'s mesh placement), letting
+  the Supervisor restart a shrunk or grown cohort.
+- ``chipmover`` — the autoscaler action that MOVES chips between
+  training and serving: sustained SLO burn preempts the training
+  cohort, reshard-restores it smaller, and hands the freed devices to
+  a new serving ``MeshReplica``; training grows back when burn clears.
+"""
+
+from tpudl.fleet.chipmover import ChipMover, ChipMoverConfig, ElasticTrainer
+from tpudl.fleet.meshrep import MeshReplica, build_mesh_session, serving_mesh
+from tpudl.fleet.reshard import (
+    ELASTIC_RESNET_RULES,
+    elastic_shardings,
+    reshard_restore,
+)
+from tpudl.fleet.transport import (
+    FileChannel,
+    MigrationEndpoint,
+    TransportError,
+    deliver_to_session,
+    migrate_request,
+    recv_frame,
+    send_frame,
+    send_migration,
+)
+
+__all__ = [
+    "ChipMover",
+    "ChipMoverConfig",
+    "ElasticTrainer",
+    "MeshReplica",
+    "build_mesh_session",
+    "serving_mesh",
+    "ELASTIC_RESNET_RULES",
+    "elastic_shardings",
+    "reshard_restore",
+    "FileChannel",
+    "MigrationEndpoint",
+    "TransportError",
+    "deliver_to_session",
+    "migrate_request",
+    "recv_frame",
+    "send_frame",
+    "send_migration",
+]

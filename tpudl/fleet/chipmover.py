@@ -1,0 +1,392 @@
+"""The chip mover: devices migrate between training and serving.
+
+The PR 10 Autoscaler can only add or drain serving replicas inside a
+fixed fleet. On a real pod the fleet IS fixed — the lever that remains
+is WHICH WORKLOAD each chip runs. This module is that lever:
+
+- ``ElasticTrainer`` — a training cohort over an explicit device
+  grant, driven end-to-end by contracts earlier PRs shipped: the step
+  compiles per mesh+rules (``compile_step``), preemption is the PR 4
+  SIGTERM protocol (``tpudl.ft.preemption``: signal -> cooperative
+  stop -> EMERGENCY checkpoint inside the grace window), and every
+  (re)start goes through ``resume_run`` + the PR 19 elastic
+  reshard-restore — so the cohort restarts on a SMALLER or LARGER
+  device grant with bitwise-identical params/opt state and a
+  schedule-identical data position.
+- ``ChipMover`` — the autoscaler escalation: under SUSTAINED SLO burn
+  (the router's ``load_report()["burning"]``, same signal the
+  Autoscaler reads) it preempts the training cohort, restarts it on a
+  subset of its devices, and hands the freed chips to a freshly
+  spawned serving ``MeshReplica`` (``router.add_replica`` — placement
+  picks it up immediately). When burn stays clear, the borrowed
+  replica DRAINS (migration-first, zero dropped results) and training
+  grows back to its full grant. Hysteresis + cooldown mirror the
+  Autoscaler's evaluate() tick shape, so a driver can run both.
+
+Knobs: ``TPUDL_FLEET_BURN_SUSTAIN_S`` / ``TPUDL_FLEET_CLEAR_SUSTAIN_S``
+(how long burn must persist/stay clear before chips move),
+``TPUDL_FLEET_COOLDOWN_S`` (min gap between moves),
+``TPUDL_FLEET_SERVE_SHARE`` (fraction of training devices a move
+lends to serving).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+from tpudl.analysis.registry import env_float
+from tpudl.ft import preemption
+from tpudl.fleet.reshard import ELASTIC_RESNET_RULES, cohort_mesh
+from tpudl.obs import registry
+
+
+class ElasticTrainer:
+    """An elastically-restartable training cohort (in-process tier).
+
+    One worker thread runs ``fit`` over a mesh built from the current
+    device grant. ``preempt()`` delivers the real SIGTERM protocol to
+    this process (handlers installed by ``start()``, main thread);
+    ``fit`` stops between steps, commits the emergency checkpoint, and
+    the watchdog is disarmed once the cooperative path completes.
+    ``restart(devices)`` resumes from the newest committed checkpoint
+    onto a mesh over the NEW grant — the reshard-restore path — and
+    continues toward ``total_steps`` with the data iterator
+    fast-forwarded (``resume_run``).
+
+    ``make_state`` / ``make_batches`` are factories (a restart needs a
+    fresh template and a fresh iterator to seek); ``step_fn`` is the
+    uncompiled train step — it recompiles per mesh shape, which is the
+    honest cost of moving chips.
+    """
+
+    def __init__(
+        self,
+        make_state: Callable[[], Any],
+        step_fn: Callable,
+        make_batches: Callable[[], Any],
+        manager,
+        devices: Sequence[jax.Device],
+        total_steps: int,
+        rules=ELASTIC_RESNET_RULES,
+        spec=None,
+        seed: int = 0,
+        checkpoint_every: int = 1,
+        install_signal_handlers: bool = True,
+    ):
+        self.make_state = make_state
+        self.step_fn = step_fn
+        self.make_batches = make_batches
+        self.manager = manager
+        self.devices: List[jax.Device] = list(devices)
+        self.total_steps = int(total_steps)
+        self.rules = rules
+        self.spec = spec
+        self.seed = seed
+        self.checkpoint_every = checkpoint_every
+        self._install = install_signal_handlers
+        self._installed_here = False
+        self._thread: Optional[threading.Thread] = None
+        self.state = None
+        self.last_metrics = None
+        self.last_info: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.steps_done = 0
+        self.finished = False
+        self.restarts = 0
+        self.mesh_shapes: List[tuple] = []  # one entry per (re)start
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> "ElasticTrainer":
+        if self.running:
+            return self
+        if self._install and not self._installed_here:
+            # Main-thread requirement is the signal module's, same as
+            # preemption.install's own contract.
+            preemption.install()
+            self._installed_here = True
+        self._thread = threading.Thread(
+            target=self._run, name="tpudl-elastic-trainer", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def join(self, timeout_s: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def _run(self) -> None:
+        from tpudl.ft.supervisor import resume_run
+        from tpudl.train import compile_step, fit
+
+        try:
+            mesh = cohort_mesh(self.devices, self.spec)
+            self.mesh_shapes.append(
+                tuple(mesh.shape[a] for a in mesh.axis_names)
+            )
+            state, rng, batches, start = resume_run(
+                self.manager, self.make_state(), self.make_batches(),
+                mesh=mesh, rules=self.rules,
+            )
+            if rng is None:
+                rng = jax.random.key(self.seed)
+            remaining = self.total_steps - start
+            if remaining <= 0:
+                self.state, self.finished = state, True
+                return
+            compiled = compile_step(self.step_fn, mesh, state, self.rules)
+            state, metrics, info = fit(
+                compiled, state, batches, rng, num_steps=remaining,
+                checkpoint_manager=self.manager,
+                checkpoint_every=self.checkpoint_every,
+            )
+            self.state = state
+            self.last_metrics = metrics
+            self.last_info = info
+            self.steps_done = start + info["steps"]
+            self.finished = (
+                not info["preempted"]
+                and self.steps_done >= self.total_steps
+            )
+            registry().gauge("fleet_training_steps_done").set(
+                self.steps_done
+            )
+        except BaseException as e:  # surfaced by preempt()/the test
+            self.error = e
+
+    def preempt(self, timeout_s: float = 120.0) -> None:
+        """The PR 4 SIGTERM protocol, aimed at our own cohort: signal,
+        wait for fit's cooperative stop + emergency checkpoint, then
+        disarm the watchdog (reset) — the grace window must not
+        hard-exit a process whose cooperative path completed."""
+        if self.running:
+            os.kill(os.getpid(), signal.SIGTERM)
+            self._thread.join(timeout=timeout_s)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"training cohort did not stop within {timeout_s}s "
+                    f"of SIGTERM (grace window would hard-exit)"
+                )
+        self._thread = None
+        preemption.reset()
+        if self.error is not None:
+            raise self.error
+
+    def restart(self, devices: Sequence[jax.Device]) -> "ElasticTrainer":
+        """Resume the cohort on a NEW device grant (shrunk or grown):
+        the newest committed checkpoint reshard-restores onto a mesh
+        over ``devices`` and training continues schedule-identically."""
+        if self.running:
+            raise RuntimeError("preempt() the cohort before restart()")
+        self.devices = list(devices)
+        self.restarts += 1
+        registry().counter("fleet_cohort_restarts").inc()
+        return self.start()
+
+    def close(self) -> None:
+        """Stop (preempting if needed) and restore signal handlers."""
+        try:
+            if self.running:
+                self.preempt()
+        finally:
+            if self._installed_here:
+                preemption.uninstall()
+                self._installed_here = False
+
+
+@dataclasses.dataclass
+class ChipMoverConfig:
+    """Hysteresis/cooldown/split policy; None reads the knob."""
+
+    burn_sustain_s: Optional[float] = None
+    clear_sustain_s: Optional[float] = None
+    cooldown_s: Optional[float] = None
+    serve_share: Optional[float] = None
+    preempt_timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if self.burn_sustain_s is None:
+            self.burn_sustain_s = env_float(
+                "TPUDL_FLEET_BURN_SUSTAIN_S", 2.0
+            )
+        if self.clear_sustain_s is None:
+            self.clear_sustain_s = env_float(
+                "TPUDL_FLEET_CLEAR_SUSTAIN_S", 5.0
+            )
+        if self.cooldown_s is None:
+            self.cooldown_s = env_float("TPUDL_FLEET_COOLDOWN_S", 2.0)
+        if self.serve_share is None:
+            self.serve_share = env_float("TPUDL_FLEET_SERVE_SHARE", 0.5)
+        if not 0.0 < self.serve_share < 1.0:
+            raise ValueError(
+                f"serve_share must be in (0, 1) — training keeps at "
+                f"least one device — got {self.serve_share}"
+            )
+
+
+class ChipMover:
+    """Move chips between a training cohort and the serving fleet.
+
+    ``evaluate()`` is one hysteresis tick (the Autoscaler's shape —
+    drive it from the same loop): burn sustained past
+    ``burn_sustain_s`` borrows ``serve_share`` of the training devices
+    for a new serving replica; burn clear past ``clear_sustain_s``
+    returns them. ``spawn_replica(name, devices)`` builds the serving
+    replica over the freed devices (typically a
+    ``tpudl.fleet.MeshReplica`` factory closing over model/params);
+    it is NOT started — ``router.add_replica`` does that.
+
+    Two states: ``training_full`` (no loan outstanding) and
+    ``borrowed`` (one serving replica on loaned devices). One loan at
+    a time keeps the accounting auditable — an escalation ladder can
+    stack movers.
+    """
+
+    def __init__(
+        self,
+        router,
+        trainer: ElasticTrainer,
+        spawn_replica: Callable[[str, Sequence[jax.Device]], Any],
+        config: Optional[ChipMoverConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        burn_fn: Optional[Callable[[], bool]] = None,
+    ):
+        self.router = router
+        self.trainer = trainer
+        self.spawn_replica = spawn_replica
+        self.config = config or ChipMoverConfig()
+        self.clock = clock
+        self.burn_fn = burn_fn
+        self.state = "training_full"
+        self.full_devices: List[jax.Device] = list(trainer.devices)
+        self.borrowed_devices: List[jax.Device] = []
+        self.borrowed_name: Optional[str] = None
+        self.moves = 0
+        self.last_burn_cleared_s: Optional[float] = None
+        self._burn_since: Optional[float] = None
+        self._clear_since: Optional[float] = None
+        self._cooldown_until = float("-inf")
+        self._burn_started_at: Optional[float] = None
+        registry().gauge("fleet_training_devices").set(
+            len(self.full_devices)
+        )
+        registry().gauge("fleet_borrowed_devices").set(0)
+
+    def burning(self) -> bool:
+        if self.burn_fn is not None:
+            return bool(self.burn_fn())
+        return bool(self.router.load_report()["burning"])
+
+    # -- the hysteresis tick --------------------------------------------
+
+    def evaluate(self) -> Optional[str]:
+        """One tick; returns the action taken ("to_serving" /
+        "to_training") or None."""
+        now = self.clock()
+        burning = self.burning()
+        if self.state == "training_full":
+            if not burning:
+                self._burn_since = None
+                return None
+            if self._burn_since is None:
+                self._burn_since = now
+                self._burn_started_at = now
+            if (
+                now - self._burn_since >= self.config.burn_sustain_s
+                and now >= self._cooldown_until
+            ):
+                self.move_to_serving()
+                return "to_serving"
+            return None
+        # borrowed: watch for sustained clear
+        if burning:
+            self._clear_since = None
+            return None
+        if self._clear_since is None:
+            self._clear_since = now
+        if (
+            now - self._clear_since >= self.config.clear_sustain_s
+            and now >= self._cooldown_until
+        ):
+            self.move_to_training()
+            return "to_training"
+        return None
+
+    # -- the two moves --------------------------------------------------
+
+    def _split(self) -> tuple:
+        devices = list(self.full_devices)
+        n_borrow = max(1, int(round(len(devices) * self.config.serve_share)))
+        n_borrow = min(n_borrow, len(devices) - 1)
+        if n_borrow < 1:
+            raise RuntimeError(
+                f"cannot split a {len(devices)}-device cohort: training "
+                f"keeps at least one device and serving needs one"
+            )
+        return devices[: len(devices) - n_borrow], devices[len(devices) - n_borrow:]
+
+    def move_to_serving(self) -> Any:
+        """Burn sustained: preempt training (SIGTERM protocol),
+        restart it shrunk (reshard-restore), serve on the freed
+        chips."""
+        t0 = self.clock()
+        keep, freed = self._split()
+        self.trainer.preempt(timeout_s=self.config.preempt_timeout_s)
+        self.trainer.restart(keep)
+        self.moves += 1
+        name = f"borrowed-{self.moves}"
+        replica = self.spawn_replica(name, freed)
+        self.router.add_replica(replica)
+        self.state = "borrowed"
+        self.borrowed_devices = list(freed)
+        self.borrowed_name = name
+        self._clear_since = None
+        self._cooldown_until = self.clock() + self.config.cooldown_s
+        reg = registry()
+        reg.counter("fleet_chip_moves_total").inc()
+        reg.gauge("fleet_training_devices").set(len(keep))
+        reg.gauge("fleet_borrowed_devices").set(len(freed))
+        reg.histogram("fleet_chipmover_move_s").observe(
+            self.clock() - t0
+        )
+        return replica
+
+    def move_to_training(self) -> None:
+        """Burn cleared: drain the borrowed replica (migration-first,
+        zero dropped results), then grow training back to its full
+        grant."""
+        t0 = self.clock()
+        if self.borrowed_name is not None:
+            self.router.remove_replica(self.borrowed_name, drain=True)
+        self.trainer.preempt(timeout_s=self.config.preempt_timeout_s)
+        self.trainer.restart(self.full_devices)
+        if self._burn_started_at is not None:
+            self.last_burn_cleared_s = self.clock() - self._burn_started_at
+            registry().histogram("fleet_burn_cleared_s").observe(
+                self.last_burn_cleared_s
+            )
+            self._burn_started_at = None
+        self.state = "training_full"
+        self.borrowed_devices = []
+        self.borrowed_name = None
+        self._burn_since = None
+        self._cooldown_until = self.clock() + self.config.cooldown_s
+        self.moves += 1
+        reg = registry()
+        reg.counter("fleet_chip_moves_total").inc()
+        reg.gauge("fleet_training_devices").set(len(self.full_devices))
+        reg.gauge("fleet_borrowed_devices").set(0)
+        reg.histogram("fleet_chipmover_move_s").observe(
+            self.clock() - t0
+        )

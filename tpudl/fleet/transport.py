@@ -1,0 +1,342 @@
+"""Cross-process migration transport: the PR 13 payload over a wire.
+
+The serving migration payload (tpudl.serve.cache.pack_migration) is
+already bytes-on-the-wire by construction — magic + versioned JSON
+meta + raw KV leaves + crc32, carrying paged KV pages, generated
+tokens, the sampling position, the ABSOLUTE deadline, and (PR 19) the
+speculative draft's KV remainder. Inside one process the router moves
+those bytes between replica threads with a deque append. This module
+moves the SAME bytes across a process boundary, so failover crosses
+hosts instead of threads:
+
+- ``send_frame`` / ``recv_frame`` — length-prefixed framing over any
+  socket (magic-checked, size-capped; the payload's own crc is
+  verified by the RECEIVING engine thread, so a corrupted transfer
+  becomes that request's ``failed`` Result, never a transport crash —
+  the exact contract the in-process path has).
+- ``MigrationEndpoint`` — a listening socket on the survivor process:
+  every received payload is handed to a ``deliver`` callback
+  (``deliver_to_session`` seats it on a local engine's migrate inbox;
+  a pod runs one endpoint per serving process).
+- ``send_migration`` — the source-side client: connect, frame each
+  payload, close.
+- ``FileChannel`` — the spool-file alternative for hosts that share a
+  filesystem but no network path (or for handoff across a process
+  RESTART): tmp-write + fsync + atomic rename, so a reader never
+  observes a torn payload — the checkpoint store's commit protocol
+  applied to migration bytes.
+
+Resume-on-survivor: ``migrate_request`` exports a mid-stream request
+from a local session (``Engine.export_request`` — the commit point
+frees the source slot only once the payload exists) and ships it;
+``deliver_to_session`` on the other end enqueues it exactly as a
+router-local migration would, and the engine resumes the decode with
+ZERO re-prefill. Greedy continuations are token-for-token identical
+to an unmigrated run (tests/test_fleet_pod.py pins this across a real
+subprocess, speculative draft state included).
+
+Knobs: ``TPUDL_FLEET_TRANSPORT_HOST`` (bind/connect host for
+endpoints, default 127.0.0.1), ``TPUDL_FLEET_TRANSPORT_TIMEOUT_S``
+(socket send/recv timeout), ``TPUDL_FLEET_SPOOL_DIR`` (default
+directory for ``FileChannel()``).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import uuid
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from tpudl.analysis.registry import env_float, env_str
+from tpudl.obs import registry
+
+#: Frame magic: distinct from the payload's own TPUDLMIG magic so a
+#: stream misaligned by one lost byte fails loudly at the frame layer.
+FRAME_MAGIC = b"TPDLFRM1"
+#: Refuse absurd frames before allocating for them (a corrupt length
+#: prefix must not OOM the survivor). 1 GiB >> any KV payload.
+MAX_FRAME_BYTES = 1 << 30
+
+_LEN = struct.Struct("<Q")
+
+
+class TransportError(RuntimeError):
+    """A framing/channel failure (bad magic, truncated stream,
+    oversized frame). Distinct from the payload-level
+    MigrationCorruptError the engine raises — transport errors mean
+    the BYTES never arrived whole, so the caller still holds the
+    payload and can retry or resubmit."""
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    """Write one length-prefixed payload frame."""
+    sock.sendall(FRAME_MAGIC + _LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None  # clean EOF between frames
+            raise TransportError(
+                f"stream truncated mid-frame ({len(buf)}/{n} bytes)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    """Read one frame; None on a clean end-of-stream."""
+    header = _recv_exact(sock, len(FRAME_MAGIC) + _LEN.size)
+    if header is None:
+        return None
+    magic, raw_len = (
+        header[: len(FRAME_MAGIC)], header[len(FRAME_MAGIC):]
+    )
+    if magic != FRAME_MAGIC:
+        raise TransportError(f"bad frame magic {magic!r}")
+    (length,) = _LEN.unpack(raw_len)
+    if length > MAX_FRAME_BYTES:
+        raise TransportError(f"frame of {length} bytes exceeds cap")
+    payload = _recv_exact(sock, length)
+    if payload is None or len(payload) != length:
+        raise TransportError("stream truncated inside frame body")
+    return payload
+
+
+def _default_host() -> str:
+    return env_str("TPUDL_FLEET_TRANSPORT_HOST") or "127.0.0.1"
+
+
+def _default_timeout() -> float:
+    return env_float("TPUDL_FLEET_TRANSPORT_TIMEOUT_S", 30.0)
+
+
+def payload_request_id(payload: bytes) -> Any:
+    """The request id a migration payload carries (full crc-verified
+    parse — a payload we cannot even name is refused at the door)."""
+    from tpudl.serve.cache import parse_migration
+
+    return parse_migration(payload)["request"]["request_id"]
+
+
+def deliver_to_session(session, payload: bytes) -> Any:
+    """Enqueue a received payload on a local session's migrate inbox —
+    the survivor half of resume-on-survivor. Returns the request id.
+    The engine thread re-verifies the crc and seats the request
+    mid-stream (zero re-prefill); corruption sheds it as ``failed``,
+    identical to the router-local migration path."""
+    from tpudl.serve.engine import _Migrated
+
+    rid = payload_request_id(payload)
+    session.engine.migrate_inbox.append(_Migrated(rid, payload))
+    return rid
+
+
+class MigrationEndpoint:
+    """A migration listener for one serving process.
+
+    Accepts connections on ``(host, port)`` (port 0 = ephemeral; read
+    the bound address off ``.address``) and hands every framed payload
+    to ``deliver`` on the accept thread. ``deliver`` must only enqueue
+    (``deliver_to_session`` does) — the engine thread does the
+    expensive verify/seat work, keeping the endpoint responsive while
+    a transfer streams in."""
+
+    def __init__(
+        self,
+        deliver: Callable[[bytes], Any],
+        host: Optional[str] = None,
+        port: int = 0,
+        timeout_s: Optional[float] = None,
+    ):
+        self.deliver = deliver
+        self.timeout_s = (
+            _default_timeout() if timeout_s is None else timeout_s
+        )
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host or _default_host(), port))
+        self._sock.listen(8)
+        self.address: Tuple[str, int] = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self.received = 0
+        self.errors = 0
+        self._thread = threading.Thread(
+            target=self._accept_loop,
+            name=f"tpudl-migration-endpoint-{self.address[1]}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # socket closed under us: shutting down
+            try:
+                conn.settimeout(self.timeout_s)
+                while True:
+                    payload = recv_frame(conn)
+                    if payload is None:
+                        break
+                    self.deliver(payload)
+                    self.received += 1
+                    registry().counter(
+                        "fleet_transport_payloads_received"
+                    ).inc()
+            except Exception:
+                # One bad sender must not kill the endpoint; the
+                # source still holds its payload and sees the broken
+                # connection.
+                self.errors += 1
+                registry().counter("fleet_transport_errors").inc()
+            finally:
+                conn.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        finally:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MigrationEndpoint":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def send_migration(
+    address: Tuple[str, int],
+    payloads: Sequence[bytes],
+    timeout_s: Optional[float] = None,
+) -> int:
+    """Ship payloads to a survivor's ``MigrationEndpoint``. Returns
+    total bytes sent; raises (socket error / TransportError) with the
+    payloads untouched in the caller's hands — resubmission stays
+    possible, which is the router's existing crashed-thread
+    fallback."""
+    total = 0
+    with socket.create_connection(
+        address, timeout=_default_timeout() if timeout_s is None else timeout_s
+    ) as sock:
+        for payload in payloads:
+            send_frame(sock, payload)
+            total += len(payload)
+    registry().counter("fleet_transport_payloads_sent").inc(len(payloads))
+    return total
+
+
+def migrate_request(
+    session,
+    rid: Any,
+    address: Optional[Tuple[str, int]] = None,
+    channel: Optional["FileChannel"] = None,
+    skip_prefix_tokens: int = 0,
+) -> Optional[int]:
+    """Export one mid-stream request from a local session and ship it
+    over a socket (``address``) or spool (``channel``). Returns the
+    payload size, or None when the engine declines the export (dense
+    cache / request not seated) — the caller resubmits, as the router
+    does. The export's commit point (source slot freed) only passes
+    once the payload bytes exist, and a failed send leaves them in
+    hand."""
+    payload = session.engine.export_request(
+        rid, skip_prefix_tokens=skip_prefix_tokens
+    )
+    if payload is None:
+        return None
+    if (address is None) == (channel is None):
+        raise ValueError(
+            "migrate_request needs exactly one of address / channel"
+        )
+    if address is not None:
+        send_migration(address, [payload])
+    else:
+        channel.put(payload)
+    return len(payload)
+
+
+class FileChannel:
+    """Atomic spool-file migration channel over a shared directory.
+
+    ``put`` stages to a ``.tmp`` name, fsyncs, then renames to
+    ``.mig`` — the commit protocol tpudl.ft.store uses, so a reader
+    (even one that starts AFTER the writer died) observes whole
+    payloads or nothing. ``take``/``drain`` consume oldest-first
+    (lexicographic sequence names preserve put order within a
+    process)."""
+
+    SUFFIX = ".mig"
+
+    def __init__(self, directory: Optional[str] = None):
+        directory = directory or env_str("TPUDL_FLEET_SPOOL_DIR")
+        if not directory:
+            raise ValueError(
+                "FileChannel needs a directory (or TPUDL_FLEET_SPOOL_DIR)"
+            )
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def put(self, payload: bytes) -> str:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        name = f"{seq:08d}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        tmp = os.path.join(self.directory, name + ".tmp")
+        final = os.path.join(self.directory, name + self.SUFFIX)
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, final)
+        return final
+
+    def _committed(self) -> List[str]:
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        return sorted(n for n in names if n.endswith(self.SUFFIX))
+
+    def __len__(self) -> int:
+        return len(self._committed())
+
+    def take(self) -> Optional[bytes]:
+        """Consume the oldest committed payload (None when empty).
+        Rename-claims before reading, so two drainers sharing the
+        spool never double-resume one request."""
+        for name in self._committed():
+            path = os.path.join(self.directory, name)
+            claimed = path + ".claimed"
+            try:
+                os.rename(path, claimed)
+            except OSError:
+                continue  # another drainer won this one
+            try:
+                with open(claimed, "rb") as f:
+                    return f.read()
+            finally:
+                os.unlink(claimed)
+        return None
+
+    def drain(self) -> List[bytes]:
+        out: List[bytes] = []
+        while True:
+            payload = self.take()
+            if payload is None:
+                return out
+            out.append(payload)

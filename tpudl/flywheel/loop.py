@@ -6,7 +6,14 @@ it watches the ``TenantMeter`` for tenants accruing completed records
 their samples from the durable request log through a ``SampleFilter``
 at each tenant's OWN remembered log position, trains factors with the
 ``RefreshTrainer``, and publishes via ``AdapterPool.register`` under
-the PR 14 safe-publish contract:
+the PR 14 safe-publish contract. Publication is GATED: a
+``TPUDL_FLYWHEEL_HOLDOUT_FRAC`` tail slice of each poll's sample
+stream is held out of training, and the refreshed factors must score
+no worse than the tenant's current factors on it (within
+``TPUDL_FLYWHEEL_GATE_TOL``) — a failed gate rolls back to the prior
+adapter, increments ``flywheel_promotions_rejected``, and marks the
+records consumed so the same rejected samples never retrain. The
+safe-publish contract itself:
 
 - refcount-0 residency is invalidated (pages freed, prefix reuse for
   the old factors gone with them) — the NEXT request seats the
@@ -57,6 +64,20 @@ def interval_default() -> float:
     return max(0.0, env_float("TPUDL_FLYWHEEL_INTERVAL_S", 30.0))
 
 
+def holdout_frac_default() -> float:
+    from tpudl.analysis.registry import env_float
+
+    return min(
+        0.9, max(0.0, env_float("TPUDL_FLYWHEEL_HOLDOUT_FRAC", 0.25))
+    )
+
+
+def gate_tol_default() -> float:
+    from tpudl.analysis.registry import env_float
+
+    return env_float("TPUDL_FLYWHEEL_GATE_TOL", 0.0)
+
+
 class FlywheelController:
     """Per-tenant refresh orchestration over one serving session.
 
@@ -80,6 +101,8 @@ class FlywheelController:
         min_records: Optional[int] = None,
         checkpoint_dir: Optional[str] = None,
         alpha: Optional[float] = None,
+        holdout_frac: Optional[float] = None,
+        gate_tol: Optional[float] = None,
         clock=time.time,
     ):
         self.session = session
@@ -94,6 +117,14 @@ class FlywheelController:
         self.checkpoint_dir = checkpoint_dir
         self.alpha = float(
             alpha if alpha is not None else trainer.alpha
+        )
+        self.holdout_frac = (
+            holdout_frac_default()
+            if holdout_frac is None
+            else min(0.9, max(0.0, float(holdout_frac)))
+        )
+        self.gate_tol = (
+            gate_tol_default() if gate_tol is None else float(gate_tol)
         )
         self._clock = clock
         #: completed-record count at each tenant's last refresh.
@@ -214,6 +245,23 @@ class FlywheelController:
             self._consumed[tenant] = completed
             self._positions[tenant] = position
             return None
+        # The promotion gate's held-out slice: the TAIL of this poll's
+        # sample stream (the freshest traffic — what the refreshed
+        # factors are about to serve) never reaches training. Kept
+        # deterministic so a preempted refresh resumes with the SAME
+        # split at the next poll.
+        holdout: List[dict] = []
+        train_examples = examples
+        can_gate = (
+            self.holdout_frac > 0.0
+            and len(examples) >= 2
+            and hasattr(self.trainer, "evaluate")
+        )
+        if can_gate:
+            n_hold = max(1, int(round(len(examples) * self.holdout_frac)))
+            n_hold = min(n_hold, len(examples) - 1)
+            holdout = examples[len(examples) - n_hold:]
+            train_examples = examples[: len(examples) - n_hold]
         manager = None
         if self.checkpoint_dir is not None:
             from tpudl.ft.manager import AsyncCheckpointManager
@@ -223,7 +271,7 @@ class FlywheelController:
             )
         try:
             factors, info = self.trainer.refresh(
-                examples,
+                train_examples,
                 adapter=self._adapters.get(tenant),
                 tenant=tenant,
                 log_state=position,
@@ -240,15 +288,32 @@ class FlywheelController:
             return None
         self._consumed[tenant] = completed
         self._positions[tenant] = position
-        self._adapters[tenant] = factors
         reg = obs_counters.registry()
         reg.counter("flywheel_refreshes_total").inc()
         reg.counter("flywheel_records_consumed_total").inc(
             len(examples)
         )
-        swapped = self._publish(pool, tenant, factors)
-        if not swapped:
-            self._pending_swap[tenant] = factors
+        # The promotion gate: refreshed factors must score no worse
+        # than what the tenant serves TODAY (its current factors, or
+        # the bare base before the first refresh) on the held-out
+        # slice. A failed gate rolls back completely — the prior
+        # adapter keeps serving, the new factors are dropped, and the
+        # records stay consumed (re-training on the same rejected
+        # samples every poll would loop forever).
+        gate = None
+        if can_gate and holdout:
+            held_new = self.trainer.evaluate(holdout, adapter=factors)
+            held_prior = self.trainer.evaluate(
+                holdout, adapter=self._adapters.get(tenant)
+            )
+            if held_new is not None and held_prior is not None:
+                gate = {
+                    "held_out_new": float(held_new),
+                    "held_out_prior": float(held_prior),
+                    "holdout_records": len(holdout),
+                    "passed": float(held_new)
+                    <= float(held_prior) + self.gate_tol,
+                }
         losses = info.get("losses") or []
         entry = {
             "tenant": tenant,
@@ -261,9 +326,21 @@ class FlywheelController:
                 k: v for k, v in position.items()
                 if k in ("epoch", "offset")
             },
-            "swapped": swapped,
-            "swap_ts": self._last_swap_ts if swapped else None,
+            "gate": gate,
         }
+        if gate is not None and not gate["passed"]:
+            reg.counter("flywheel_promotions_rejected").inc()
+            entry["swapped"] = False
+            entry["swap_ts"] = None
+            entry["rejected"] = True
+            self._history.append(entry)
+            return entry
+        self._adapters[tenant] = factors
+        swapped = self._publish(pool, tenant, factors)
+        if not swapped:
+            self._pending_swap[tenant] = factors
+        entry["swapped"] = swapped
+        entry["swap_ts"] = self._last_swap_ts if swapped else None
         self._history.append(entry)
         return entry
 

@@ -183,6 +183,7 @@ class RefreshTrainer:
             tx = precision_mod.apply_moment_rules(tx, self.policy)
         self._tx = tx
         self._step = jax.jit(self._build_step())
+        self._eval = None  # compiled lazily: only gated deployments pay
 
     # -- state ---------------------------------------------------------
 
@@ -307,6 +308,67 @@ class RefreshTrainer:
             return new_state, metrics
 
         return step
+
+    # -- the promotion gate's eval -------------------------------------
+
+    def _build_eval(self):
+        policy = self.policy
+        fp8_template = self._fp8_template
+
+        def ev(params, tokens, mask):
+            run_params = (
+                policy.cast_params(params)
+                if policy is not None
+                else params
+            )
+            variables = {"params": run_params}
+            if policy is not None and policy.use_fp8:
+                variables["fp8"] = fp8_template
+                logits, _ = self.model.apply(
+                    variables, tokens, mutable=["intermediates"]
+                )
+            else:
+                logits = self.model.apply(variables, tokens)
+            logits = logits.astype(
+                policy.reduce_dtype if policy is not None else jnp.float32
+            )
+            per = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]
+            )
+            w = mask[:, 1:].astype(jnp.float32)
+            return jnp.sum(per * w), jnp.sum(w)
+
+        return ev
+
+    def evaluate(
+        self,
+        examples: List[dict],
+        adapter: Optional[Dict[str, dict]] = None,
+    ) -> Optional[float]:
+        """Mean next-token loss on ``examples`` under ``adapter``
+        (None = the zero-B grafted base, i.e. exactly the serving base
+        model). Loss-only — no gradients, no optimizer — using the
+        SAME cast/reduce policy as the train step, so a gate
+        comparison between two adapters is apples-to-apples. Returns
+        None when the examples pack to zero batches (nothing to judge
+        — the gate treats that as pass-through)."""
+        batches = pack_examples(examples, self.batch_size, self.seq_len)
+        if not batches:
+            return None
+        if self._eval is None:
+            self._eval = jax.jit(self._build_eval())
+        params = self._params0
+        if adapter:
+            params = _apply_adapter(params, adapter)
+        total = 0.0
+        weight = 0.0
+        for batch in batches:
+            s, w = self._eval(params, batch["tokens"], batch["mask"])
+            total += float(s)
+            weight += float(w)
+        if weight <= 0.0:
+            return None
+        return total / weight
 
     # -- driving -------------------------------------------------------
 

@@ -1146,7 +1146,8 @@ class PagedKVCache:
 
     # -- page-granular migration ---------------------------------------
 
-    def export_request(self, slot: int, meta: dict, skip_tokens: int = 0) -> bytes:
+    def export_request(self, slot: int, meta: dict, skip_tokens: int = 0,
+                       extra_leaves=()) -> bytes:
         """Serialize one seated request's KV state into a single
         crc32-guarded payload: its logical rows ``[skip_tokens, lens)``
         gathered straight out of the page pools in STORED dtype (int8
@@ -1190,6 +1191,11 @@ class PagedKVCache:
             (jax.tree_util.keystr(path), np.asarray(arr)[skip:lens])
             for path, arr in flat
         ]
+        # Rider leaves (e.g. the speculative draft's nested payload)
+        # ship alongside the KV rows under caller-chosen paths; import
+        # reads only the paths its own pools need, so riders are
+        # crc-covered but structurally inert here.
+        leaves.extend((name, np.asarray(arr)) for name, arr in extra_leaves)
         payload_meta = dict(meta)
         payload_meta.update(
             kind="tpudl-kv-migration",

@@ -821,19 +821,22 @@ class Engine:
         position (the ``fold_in(key(seed), t)`` index), and absolute
         deadline — as a crc32-guarded payload another engine's
         ``install_migrated`` resumes byte-exact, with zero prefill
-        dispatches. Returns ``None`` when the request is not seated
-        here, the cache is dense (migration is a paged-substrate
-        feature: pages are position-independent, dense rows are not),
-        or the engine speculates (the draft cache's state is not part
-        of the transfer contract yet) — the caller's cue to fall back
-        to a from-scratch resubmission.
+        dispatches. A speculating engine additionally ships the
+        draft's KV remainder as a nested payload
+        (``Speculator.export_slot``), so draft and target cross the
+        wire in lens-lockstep and the first post-failover propose
+        window runs as if the request never moved. Returns ``None``
+        when the request is not seated here or the cache is dense
+        (migration is a paged-substrate feature: pages are
+        position-independent, dense rows are not) — the caller's cue
+        to fall back to a from-scratch resubmission.
 
         ``skip_prefix_tokens`` omits that many leading logical rows
         from the payload (the router probed AND LEASED them in the
         target's radix tree — prefix by reference, not by bytes).
         Commit-or-invisible: the slot is freed only after the payload
         exists in full."""
-        if not self.paged or self.speculator is not None:
+        if not self.paged:
             return None
         slot = next(
             (
@@ -899,10 +902,35 @@ class Engine:
             "reserve_tokens": int(self.cache.lens[slot])
             + max(0, req.max_new_tokens - len(s.tokens)),
         }
-        payload = self.cache.export_request(slot, meta, skip_tokens=skip)
+        extra_leaves = []
+        if self.speculator is not None:
+            # The draft remainder: a nested payload of the draft
+            # cache's rows (its own pack/crc), riding as one uint8
+            # leaf. Draft lens equals target lens between windows
+            # (lens-lockstep), so the reserve formula is the target's.
+            draft_reserve = int(self.speculator.cache.lens[slot]) + max(
+                0, req.max_new_tokens - len(s.tokens)
+            )
+            draft_bytes = self.speculator.export_slot(
+                slot, req.input_ids, draft_reserve
+            )
+            meta["draft"] = {
+                "k": self.speculator.k,
+                "nbytes": len(draft_bytes),
+            }
+            import numpy as _np
+
+            extra_leaves.append(
+                ("draft:payload", _np.frombuffer(draft_bytes, _np.uint8))
+            )
+        payload = self.cache.export_request(
+            slot, meta, skip_tokens=skip, extra_leaves=extra_leaves
+        )
         # Commit point: the payload exists in full — the local copy of
         # this request ends here (no double decode, no late Result).
         self.cache.free(slot)
+        if self.speculator is not None:
+            self.speculator.free(slot)
         if self.adapter_pool is not None:
             self.adapter_pool.free_slot(slot)
         self._slots[slot] = None
@@ -939,17 +967,19 @@ class Engine:
                     "migration requires a paged cache (dense rows are "
                     "not position-independent)"
                 )
-            if self.speculator is not None:
-                raise ValueError(
-                    "migration into a speculating engine is not "
-                    "supported (the draft cache is not part of the "
-                    "transfer contract)"
-                )
             meta = (
                 payload
                 if isinstance(payload, dict) and "_arrays" in payload
                 else parse_migration(payload)
             )
+            if self.speculator is not None and "draft" not in meta:
+                # A speculating engine cannot resume a draft-less
+                # payload: the draft cache would start empty while the
+                # target cache is mid-stream, breaking lens-lockstep.
+                raise MigrationCompatError(
+                    "this engine speculates but the payload carries "
+                    "no draft remainder"
+                )
             req = Request(**meta["request"])
             entry = _Entry(
                 priority=req.priority, seq=0, request=req,
@@ -998,6 +1028,23 @@ class Engine:
             self.cache.import_request(meta, slot, lease=lease)
             if self.adapter_pool is not None:
                 self.adapter_pool.bind_slot(slot, req.tenant)
+            if self.speculator is not None:
+                # Draft remainder: the rider leaf is the nested draft
+                # payload verbatim — seat it so draft/target lockstep
+                # resumes without a re-prefill on either cache. A
+                # non-speculating engine ignores the rider instead
+                # (the target import never reads it).
+                try:
+                    self.speculator.import_slot(
+                        slot, meta["_arrays"]["draft:payload"].tobytes()
+                    )
+                except BaseException:
+                    # Target rows already landed: unwind them so the
+                    # failure is invisible (both caches seat or none).
+                    self.cache.free(slot)
+                    if self.adapter_pool is not None:
+                        self.adapter_pool.free_slot(slot)
+                    raise
         except BaseException:
             if tenant_pinned:
                 self.adapter_pool.release(req.tenant)
@@ -1081,6 +1128,11 @@ class Engine:
                 self.adapter_pool.can_seat(tenant)
             ):
                 return False
+        if self.speculator is not None and "draft" in meta:
+            # Lens-lockstep means the draft reservation equals the
+            # target's — the draft cache must seat it too, right now.
+            if not self.speculator.cache.fits_tokens(reserve):
+                return False
         if self.prefix_share and meta.get("left_aligned"):
             return self.cache.fits_request(
                 meta["request"]["input_ids"], reserve
@@ -1096,6 +1148,10 @@ class Engine:
             if self.adapter_pool is None or not (
                 self.adapter_pool.can_ever_seat(tenant)
             ):
+                return False
+        if self.speculator is not None and "draft" in meta:
+            dc = self.speculator.cache
+            if dc.pages_needed(reserve) > dc.num_pages - 1:
                 return False
         return self.cache.pages_needed(reserve) <= self.cache.num_pages - 1
 

@@ -197,6 +197,38 @@ class Speculator:
         accepted tail are simply never acknowledged."""
         self.cache.advance([slot], target_len_delta)
 
+    # -- migration (the draft remainder of the PR 13 payload) -----------
+
+    def export_slot(self, slot: int, input_ids,
+                    reserve_tokens: int) -> bytes:
+        """Serialize this slot's draft KV as its own nested migration
+        payload (same pack/crc format as the target's — the draft cache
+        IS a PagedKVCache). The draft's rows differ from the target's
+        (different model), so they must ship as bytes; what makes the
+        transfer small is that the draft model is the quantized
+        self-draft. Non-destructive, like the cache export."""
+        meta = {
+            "request": {"input_ids": [int(t) for t in input_ids]},
+            "reserve_tokens": int(reserve_tokens),
+        }
+        return self.cache.export_request(slot, meta)
+
+    def import_slot(self, slot: int, draft_payload) -> None:
+        """Seat a nested draft payload into this speculator's cache at
+        ``slot`` — after this the draft is back in lens-lockstep with
+        the target's imported KV, and the next ``propose`` window runs
+        as if the request never moved. Raises the cache's
+        MigrationCorrupt/CompatError on a payload this draft cannot
+        seat (different draft geometry, quantization mismatch)."""
+        from tpudl.serve.cache import parse_migration
+
+        meta = (
+            draft_payload
+            if isinstance(draft_payload, dict) and "_arrays" in draft_payload
+            else parse_migration(draft_payload)
+        )
+        self.cache.import_request(meta, slot)
+
     # -- the propose loop ----------------------------------------------
 
     def propose(
